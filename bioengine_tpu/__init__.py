@@ -1,0 +1,27 @@
+"""BioEngine-TPU — a TPU-native execution layer for bioimage AI.
+
+Built from scratch with the capabilities of aicell-lab/bioengine-worker
+(reference: /root/reference), but an idiomatic JAX/XLA/pjit design:
+
+- ``bioengine_tpu.cluster``   — TPU slice provisioning & cluster state
+  (replaces the reference's Ray cluster manager, ref bioengine/cluster/).
+- ``bioengine_tpu.serving``   — serving controller with health-checked
+  replicas pinned to device meshes and continuous batching (replaces
+  Ray Serve usage in ref bioengine/apps/).
+- ``bioengine_tpu.runtime``   — XLA inference/training runtime with a
+  compiled-program cache (replaces the CUDA pipeline cache at ref
+  apps/model-runner/runtime_deployment.py:160-232).
+- ``bioengine_tpu.parallel``  — mesh/sharding utilities: data-parallel
+  pjit training, spatial (halo-exchange) sharding for tiled images,
+  ring attention for long token sequences.
+- ``bioengine_tpu.apps``      — manifest-driven application system
+  (ref bioengine/apps/builder.py + manager.py).
+- ``bioengine_tpu.datasets``  — Zarr-over-HTTP dataset streaming with a
+  byte-LRU chunk cache and TPU-aware prefetch (ref bioengine/datasets/).
+- ``bioengine_tpu.rpc``       — Hypha-compatible WebSocket RPC control
+  plane (service registration, per-method ACLs) usable standalone.
+- ``bioengine_tpu.worker``    — the BioEngineWorker orchestrator and
+  admin code executor (ref bioengine/worker/).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,14 @@
+"""``bioengine`` CLI entry point (subcommands land with the CLI milestone)."""
+
+from __future__ import annotations
+
+import click
+
+
+@click.group()
+def main() -> None:
+    """BioEngine-TPU command line interface."""
+
+
+if __name__ == "__main__":
+    main()

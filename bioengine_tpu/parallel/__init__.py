@@ -1,0 +1,3 @@
+from bioengine_tpu.parallel.mesh import MeshSpec, make_mesh
+
+__all__ = ["MeshSpec", "make_mesh"]

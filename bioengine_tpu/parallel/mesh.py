@@ -1,0 +1,81 @@
+"""Device-mesh construction for BioEngine-TPU.
+
+The framework's parallelism axes:
+
+- ``dp`` — data parallel (batch sharding; gradients all-reduced over ICI)
+- ``sp`` — spatial/sequence parallel (image tiles with halo exchange, or
+  token-sequence shards for ring attention)
+- ``tp`` — tensor parallel (reserved; weight sharding for large models)
+
+The reference has no device-mesh concept at all — its unit of parallelism
+is a whole Ray Serve replica (ref apps/proxy_deployment.py:36-44). Here a
+replica *owns* a mesh, and scaling happens in units of replicas, each with
+a fixed sub-mesh, so XLA programs never need recompiling on scale events
+(see SURVEY.md §7 "Replica elasticity vs. XLA's static world").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh description, serializable into app manifests."""
+
+    axes: Mapping[str, int]  # ordered axis name -> size; -1 = fill
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = dict(self.axes)
+        fill_axes = [k for k, v in sizes.items() if v == -1]
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if n_devices % fixed:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fixed axes {sizes}"
+            )
+        remaining = n_devices // fixed
+        if not fill_axes:
+            if fixed != n_devices:
+                raise ValueError(
+                    f"Mesh {sizes} needs {fixed} devices, have {n_devices}"
+                )
+            return sizes
+        if len(fill_axes) > 1:
+            raise ValueError("At most one axis may be -1")
+        sizes[fill_axes[0]] = remaining
+        return sizes
+
+
+def make_mesh(
+    axes: Mapping[str, int],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a named Mesh over ``devices`` (default: all local devices).
+
+    Device ordering follows JAX's enumeration, which on TPU follows the
+    physical torus — adjacent mesh coordinates land on ICI neighbours, so
+    ``psum`` over the innermost axis rides the fastest links.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = MeshSpec(axes).resolve(len(devices))
+    arr = np.array(devices).reshape(tuple(sizes.values()))
+    return Mesh(arr, tuple(sizes.keys()))
+
+
+def sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_device_mesh(n: int = 1, axis: str = "dp") -> Mesh:
+    """A mesh over the first ``n`` local devices (single-replica case)."""
+    return make_mesh({axis: n}, jax.devices()[:n])

@@ -1,0 +1,4 @@
+from bioengine_tpu.utils.logger import create_logger
+from bioengine_tpu.utils.permissions import check_permissions, create_context
+
+__all__ = ["create_logger", "check_permissions", "create_context"]

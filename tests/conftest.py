@@ -1,0 +1,43 @@
+"""Hermetic test fixtures.
+
+All tests run on the CPU XLA backend with 8 virtual devices so sharding
+code paths (dp/sp meshes, halo exchange, ring attention) are exercised
+without TPU hardware. This must happen before jax is imported anywhere.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    from bioengine_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(axes={"dp": 2, "sp": 4}, devices=devices)
+
+
+@pytest.fixture()
+def tmp_workspace(tmp_path):
+    ws = tmp_path / "workspace"
+    ws.mkdir()
+    return ws

@@ -1,0 +1,121 @@
+import logging
+
+import pytest
+
+from bioengine_tpu.utils.logger import create_logger, read_log_tail
+from bioengine_tpu.utils.network import acquire_free_port, get_internal_ip
+from bioengine_tpu.utils.permissions import (
+    check_permissions,
+    create_context,
+    is_authorized,
+)
+from bioengine_tpu.utils.requirements import (
+    get_pip_requirements,
+    normalize_requirement,
+    update_requirements,
+)
+
+pytestmark = pytest.mark.unit
+
+
+class TestPermissions:
+    def test_wildcard_allows_any_user(self):
+        ctx = create_context("alice")
+        check_permissions(ctx, ["*"])
+
+    def test_user_id_match(self):
+        ctx = create_context("alice")
+        check_permissions(ctx, ["alice"])
+
+    def test_email_match(self):
+        ctx = create_context("alice", email="alice@lab.org")
+        check_permissions(ctx, ["alice@lab.org"])
+
+    def test_workspace_match(self):
+        ctx = create_context("alice", workspace="ws-team")
+        check_permissions(ctx, ["ws-team"])
+
+    def test_empty_list_denies(self):
+        ctx = create_context("alice")
+        with pytest.raises(PermissionError):
+            check_permissions(ctx, [])
+
+    def test_mismatch_denies(self):
+        ctx = create_context("mallory")
+        with pytest.raises(PermissionError):
+            check_permissions(ctx, ["alice", "bob"])
+
+    def test_missing_context_denies(self):
+        with pytest.raises(PermissionError):
+            check_permissions(None, ["*"])
+
+    def test_is_authorized_bool(self):
+        assert is_authorized(create_context("a"), ["*"])
+        assert not is_authorized(create_context("a"), ["b"])
+
+
+class TestNetwork:
+    def test_internal_ip_is_ipv4(self):
+        ip = get_internal_ip()
+        parts = ip.split(".")
+        assert len(parts) == 4 and all(0 <= int(p) <= 255 for p in parts)
+
+    def test_acquire_os_assigned_port(self):
+        port, sock = acquire_free_port()
+        assert port > 0 and sock is None
+
+    def test_held_port_stays_bound(self):
+        port, sock = acquire_free_port(hold=True)
+        try:
+            import socket
+
+            s2 = socket.socket()
+            with pytest.raises(OSError):
+                s2.bind(("0.0.0.0", port))
+            s2.close()
+        finally:
+            sock.close()
+
+    def test_range_scan(self):
+        port, _ = acquire_free_port(40000, 40100)
+        assert 40000 <= port <= 40100
+
+
+class TestLogger:
+    def test_console_only(self):
+        log = create_logger("t1", log_file="off")
+        assert log.name == "bioengine.t1"
+        assert len(log.handlers) == 1
+
+    def test_file_logging_and_tail(self, tmp_path):
+        f = tmp_path / "t2.log"
+        log = create_logger("t2", level=logging.DEBUG, log_file=f)
+        log.info("hello-world")
+        for h in log.handlers:
+            h.flush()
+        assert "hello-world" in f.read_text()
+        assert "hello-world" in read_log_tail("t2")
+
+
+class TestRequirements:
+    def test_normalize_rewrites_operator_keeps_version(self):
+        assert normalize_requirement("numpy>=1.26") == "numpy==1.26"
+        assert normalize_requirement("pkg~=2.1.0") == "pkg==2.1.0"
+
+    def test_normalize_bare_name_passthrough(self):
+        assert normalize_requirement("not-a-real-pkg-xyz") == "not-a-real-pkg-xyz"
+
+    def test_skip_is_exact_name_not_prefix(self):
+        reqs = update_requirements(["jaxtyping==0.2.0", "torchmetrics>=1.0"])
+        names = [r.split("==")[0] for r in reqs]
+        assert "jaxtyping" in names and "torchmetrics" in names
+
+    def test_injection_skips_compute_stack(self):
+        reqs = update_requirements(["jax>=0.4", "flax", "somepkg==1.0"])
+        names = [r.split("==")[0] for r in reqs]
+        assert "jax" not in names and "flax" not in names
+        assert "somepkg" in names
+
+    def test_framework_pins_present(self):
+        names = [r.split("==")[0] for r in get_pip_requirements()]
+        assert "numpy" in names
