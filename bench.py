@@ -1,0 +1,67 @@
+"""Headline benchmark: DINOv2-geometry ViT-B/14 embedding throughput.
+
+Comparable to the reference's published number — ~500 images/sec on one
+A100 (fp16, batch 64) for DINOv2 ViT-B/14 cell-crop embedding
+(ref apps/cell-image-search/README.md:122, embedder.py:11,40-70).
+Here: the same geometry in bf16 on one TPU chip via the framework's
+jitted Flax ViT. ``vs_baseline`` = images/sec / 500.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+
+Env overrides for local debugging:
+  BENCH_PLATFORM=cpu   run on host CPU (tiny batch, not a real number)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    if os.environ.get("BENCH_PLATFORM", "").lower() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        batch, iters, warmup = 4, 3, 1
+    else:
+        import jax
+
+        batch, iters, warmup = 64, 10, 3
+
+    import jax.numpy as jnp
+
+    from bioengine_tpu.models.vit import ViT
+
+    model = ViT(patch_size=14, dim=768, depth=12, num_heads=12)  # ViT-B/14
+    images = jnp.zeros((batch, 224, 224, 3), jnp.float32)
+    params = model.init(jax.random.key(0), images)["params"]
+
+    fwd = jax.jit(lambda p, x: model.apply({"params": p}, x))
+    for _ in range(warmup):
+        fwd(params, images).block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fwd(params, images)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch * iters / dt
+    print(
+        json.dumps(
+            {
+                "metric": "dinov2_vitb14_embed_images_per_sec_per_chip",
+                "value": round(images_per_sec, 2),
+                "unit": "images/sec",
+                "vs_baseline": round(images_per_sec / 500.0, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,66 @@
+"""Builtin model registry.
+
+Maps model-zoo style names to Flax module factories. The reference's
+analog is the bioimageio collection lookup + torch model load (ref
+apps/model-runner/entry_deployment.py:1306-1366); here builtin
+architectures are constructed directly and external weights attach via
+``bioengine_tpu.runtime.convert``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from flax import linen as nn
+
+_REGISTRY: dict[str, Callable[..., nn.Module]] = {}
+
+
+def register_model(name: str):
+    def deco(factory: Callable[..., nn.Module]):
+        _REGISTRY[name] = factory
+        return factory
+
+    return deco
+
+
+def get_model(name: str, **overrides: Any) -> nn.Module:
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"Unknown model '{name}'. Available: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[name](**overrides)
+
+
+def list_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+@register_model("unet2d")
+def _unet2d(**kw) -> nn.Module:
+    from bioengine_tpu.models.unet import UNet2D
+
+    return UNet2D(**kw)
+
+
+@register_model("cellpose")
+def _cellpose(**kw) -> nn.Module:
+    from bioengine_tpu.models.cellpose import CellposeNet
+
+    return CellposeNet(**kw)
+
+
+@register_model("vit-b14")
+def _vit_b14(**kw) -> nn.Module:
+    from bioengine_tpu.models.vit import ViT
+
+    return ViT(**kw)
+
+
+@register_model("vit-s14")
+def _vit_s14(**kw) -> nn.Module:
+    from bioengine_tpu.models.vit import ViT
+
+    kw.setdefault("dim", 384)
+    kw.setdefault("num_heads", 6)
+    return ViT(**kw)

@@ -1,0 +1,65 @@
+"""2D U-Net in Flax — the workhorse architecture for BioImage Model Zoo
+segmentation models (the reference runs these through bioimageio.core's
+torch path, ref apps/model-runner/runtime_deployment.py:234-312).
+
+TPU-first choices:
+- NHWC layout (XLA's native conv layout on TPU; feeds the MXU directly).
+- GroupNorm instead of BatchNorm: batch-size independent, so the same
+  compiled program serves batch 1..N without retraining statistics.
+- bf16 compute / f32 params by default; the matmul-heavy convs hit the
+  MXU in bf16 while the loss/optimizer stay f32.
+- Static pool/upsample factors only — no dynamic shapes inside jit.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class ConvBlock(nn.Module):
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        for _ in range(2):
+            x = nn.Conv(self.features, (3, 3), padding="SAME", dtype=self.dtype)(x)
+            x = nn.GroupNorm(num_groups=min(32, self.features), dtype=self.dtype)(x)
+            x = nn.silu(x)
+        return x
+
+
+class UNet2D(nn.Module):
+    """Encoder-decoder with skip connections.
+
+    in: (B, H, W, C_in) with H, W divisible by 2**len(features[:-1]).
+    out: (B, H, W, out_channels) logits.
+    """
+
+    features: Sequence[int] = (32, 64, 128, 256)
+    out_channels: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        skips = []
+        for feats in self.features[:-1]:
+            x = ConvBlock(feats, self.dtype)(x)
+            skips.append(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = ConvBlock(self.features[-1], self.dtype)(x)
+        for feats, skip in zip(reversed(self.features[:-1]), reversed(skips)):
+            x = nn.ConvTranspose(
+                feats, (2, 2), strides=(2, 2), dtype=self.dtype
+            )(x)
+            x = jnp.concatenate([x, skip], axis=-1)
+            x = ConvBlock(feats, self.dtype)(x)
+        return nn.Conv(self.out_channels, (1, 1), dtype=jnp.float32)(x)
+
+    @property
+    def divisor(self) -> int:
+        return 2 ** (len(self.features) - 1)

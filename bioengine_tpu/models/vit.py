@@ -1,0 +1,126 @@
+"""Vision Transformer embedder (DINOv2-compatible geometry).
+
+The reference embeds cell crops with torch DINOv2 ViT-B/14 at fp16
+(ref apps/cell-image-search/embedder.py:40-70, ~500 img/s on one A100).
+This is the TPU-native equivalent: a Flax ViT whose weights can be
+converted from the torch checkpoint (bioengine_tpu.runtime.convert),
+run in bf16 so attention/MLP matmuls tile onto the MXU, and sharded
+data-parallel across a pod for corpus embedding.
+
+Attention can route through the Pallas flash kernel for long token
+sequences (bioengine_tpu.ops.pallas.attention) or ring attention when
+the sequence axis is sharded (bioengine_tpu.parallel.ring).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MlpBlock(nn.Module):
+    hidden: int
+    out: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(self.hidden, dtype=self.dtype)(x)
+        x = nn.gelu(x)
+        return nn.Dense(self.out, dtype=self.dtype)(x)
+
+
+class Attention(nn.Module):
+    dim: int
+    num_heads: int
+    dtype: jnp.dtype = jnp.bfloat16
+    # Optional kernel override: fn(q, k, v) -> out, shapes (B, H, N, d).
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        B, N, _ = x.shape
+        head_dim = self.dim // self.num_heads
+        qkv = nn.Dense(self.dim * 3, dtype=self.dtype, name="qkv")(x)
+        qkv = qkv.reshape(B, N, 3, self.num_heads, head_dim)
+        q, k, v = jnp.moveaxis(qkv, 2, 0)  # each (B, N, H, d)
+        q = jnp.swapaxes(q, 1, 2)  # (B, H, N, d)
+        k = jnp.swapaxes(k, 1, 2)
+        v = jnp.swapaxes(v, 1, 2)
+        if self.attn_fn is not None:
+            out = self.attn_fn(q, k, v)
+        else:
+            scale = head_dim**-0.5
+            logits = jnp.einsum("bhnd,bhmd->bhnm", q * scale, k)
+            weights = nn.softmax(logits.astype(jnp.float32), axis=-1)
+            out = jnp.einsum("bhnm,bhmd->bhnd", weights.astype(self.dtype), v)
+        out = jnp.swapaxes(out, 1, 2).reshape(B, N, self.dim)
+        return nn.Dense(self.dim, dtype=self.dtype, name="proj")(out)
+
+
+class Block(nn.Module):
+    dim: int
+    num_heads: int
+    mlp_ratio: float = 4.0
+    dtype: jnp.dtype = jnp.bfloat16
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        # DINOv2 uses pre-norm + LayerScale; gamma converts from torch ls1/ls2.
+        y = nn.LayerNorm(dtype=jnp.float32, name="norm1")(x)
+        y = Attention(self.dim, self.num_heads, self.dtype, self.attn_fn, name="attn")(y)
+        y = y * self.param("ls1", nn.initializers.ones, (self.dim,), jnp.float32)
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32, name="norm2")(x)
+        y = MlpBlock(int(self.dim * self.mlp_ratio), self.dim, self.dtype, name="mlp")(y)
+        y = y * self.param("ls2", nn.initializers.ones, (self.dim,), jnp.float32)
+        return x + y
+
+
+class ViT(nn.Module):
+    """ViT-B/14 defaults match DINOv2-base (embed 768, 12 heads, 12 blocks)."""
+
+    patch_size: int = 14
+    dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_ratio: float = 4.0
+    dtype: jnp.dtype = jnp.bfloat16
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, images):
+        """images: (B, H, W, 3) with H, W divisible by patch_size.
+
+        Returns the CLS embedding (B, dim) in f32 — the similarity-search
+        feature vector.
+        """
+        B, H, W, _ = images.shape
+        x = nn.Conv(
+            self.dim,
+            (self.patch_size, self.patch_size),
+            strides=(self.patch_size, self.patch_size),
+            dtype=self.dtype,
+            name="patch_embed",
+        )(images.astype(self.dtype))
+        n_patches = (H // self.patch_size) * (W // self.patch_size)
+        x = x.reshape(B, n_patches, self.dim)
+        cls = self.param("cls_token", nn.initializers.zeros, (1, 1, self.dim), jnp.float32)
+        x = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, self.dim)).astype(self.dtype), x], axis=1)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (1, n_patches + 1, self.dim),
+            jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+        for i in range(self.depth):
+            x = Block(
+                self.dim, self.num_heads, self.mlp_ratio, self.dtype,
+                self.attn_fn, name=f"block{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="norm")(x)
+        return x[:, 0].astype(jnp.float32)

@@ -1,0 +1,88 @@
+"""Spatial (image-domain) parallelism with halo exchange.
+
+The reference's closest analog is *serial* tiling: fibsem-mito-analysis
+cuts a large EM image into 512^2 tiles and calls the model per tile over
+RPC (ref apps/fibsem-mito-analysis/analysis_deployment.py:10-14), and
+bioimageio blockwise prediction does the same in-process. Neither is
+parallel. Here the image's height axis is sharded over the mesh's ``sp``
+axis and convolutional halos are exchanged with ``ppermute`` over ICI —
+one jitted program, N chips, no stitching artifacts (exact, not
+blended: every output pixel sees the same receptive field as the
+unsharded model).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def halo_exchange(x: jax.Array, halo: int, axis_name: str) -> jax.Array:
+    """Pad a height-sharded block with ``halo`` rows from ring neighbours.
+
+    x: (B, H_local, W, C) inside shard_map. Returns
+    (B, H_local + 2*halo, W, C). Edge shards receive zeros (same as a
+    zero-padded unsharded conv).
+    """
+    if halo == 0:
+        return x
+    idx = jax.lax.axis_index(axis_name)
+    n = jax.lax.axis_size(axis_name)
+    top_rows = x[:, :halo]          # my first rows -> neighbour below...
+    bot_rows = x[:, -halo:]         # my last rows -> neighbour above
+    # Send my bottom rows DOWN the ring (shard i -> i+1) so each shard
+    # receives its upper neighbour's bottom rows.
+    from_above = jax.lax.ppermute(
+        bot_rows, axis_name, [(i, (i + 1) % n) for i in range(n)]
+    )
+    # Send my top rows UP the ring (i -> i-1): receive lower neighbour's top.
+    from_below = jax.lax.ppermute(
+        top_rows, axis_name, [(i, (i - 1) % n) for i in range(n)]
+    )
+    # Zero out wrap-around contributions at the edges.
+    from_above = jnp.where(idx == 0, jnp.zeros_like(from_above), from_above)
+    from_below = jnp.where(
+        idx == n - 1, jnp.zeros_like(from_below), from_below
+    )
+    return jnp.concatenate([from_above, x, from_below], axis=1)
+
+
+def spatial_shard_apply(
+    apply_fn: Callable[[Any, jax.Array], jax.Array],
+    mesh: Mesh,
+    halo: int,
+    axis: str = "sp",
+) -> Callable[[Any, jax.Array], jax.Array]:
+    """Lift ``apply_fn`` (params, (B,H,W,C)) -> (B,H,W,C') to a
+    height-sharded SPMD program.
+
+    The wrapped fn takes the FULL image; jit + shard_map split H over
+    ``axis``, exchange halos, run the model per-shard on the haloed
+    block, and crop the halo off the output. Correct for models whose
+    receptive-field radius <= halo and whose output stride is 1.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(None, axis, None, None)),
+        out_specs=P(None, axis, None, None),
+    )
+    def sharded(params, block):
+        haloed = halo_exchange(block, halo, axis)
+        out = apply_fn(params, haloed)
+        return out[:, halo:-halo] if halo else out
+
+    return jax.jit(sharded)
+
+
+def shard_image(mesh: Mesh, image, axis: str = "sp"):
+    """Place (B, H, W, C) with H sharded over ``axis``."""
+    return jax.device_put(
+        image, NamedSharding(mesh, P(None, axis, None, None))
+    )

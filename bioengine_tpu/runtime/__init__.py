@@ -1,0 +1,16 @@
+from bioengine_tpu.runtime.buckets import bucket_shape, pad_to, crop_to
+from bioengine_tpu.runtime.engine import EngineConfig, InferenceEngine
+from bioengine_tpu.runtime.program_cache import (
+    CompiledProgramCache,
+    default_program_cache,
+)
+
+__all__ = [
+    "bucket_shape",
+    "pad_to",
+    "crop_to",
+    "EngineConfig",
+    "InferenceEngine",
+    "CompiledProgramCache",
+    "default_program_cache",
+]

@@ -1,0 +1,70 @@
+"""Shape bucketing — the answer to XLA recompilation on arbitrary
+microscopy image sizes (SURVEY.md §7 "Dynamic shapes").
+
+Every (H, W) is rounded up to a canonical bucket; inputs are zero-padded
+to the bucket and outputs cropped back. One compiled program per bucket,
+so a screening workload over mixed image sizes triggers a small, bounded
+number of compilations instead of one per unique shape.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import numpy as np
+
+# Default spatial ladder: MXU/VPU-friendly multiples, growing ~1.5x so
+# padding waste is bounded by ~55% worst case, typically <20%.
+DEFAULT_LADDER = (64, 128, 192, 256, 384, 512, 768, 1024, 1536, 2048)
+
+
+def bucket_dim(size: int, ladder: Sequence[int] = DEFAULT_LADDER, divisor: int = 1) -> int:
+    """Smallest ladder entry >= size that is divisible by ``divisor``.
+
+    Falls back to rounding up to the next multiple of max(divisor, 128)
+    above the ladder.
+    """
+    for b in ladder:
+        if b >= size and b % divisor == 0:
+            return b
+    step = max(divisor, 128)
+    return math.ceil(size / step) * step
+
+
+def bucket_shape(
+    hw: tuple[int, int],
+    ladder: Sequence[int] = DEFAULT_LADDER,
+    divisor: int = 1,
+) -> tuple[int, int]:
+    return (
+        bucket_dim(hw[0], ladder, divisor),
+        bucket_dim(hw[1], ladder, divisor),
+    )
+
+
+def bucket_batch(n: int, ladder: Sequence[int] = (1, 2, 4, 8, 16, 32, 64)) -> int:
+    for b in ladder:
+        if b >= n:
+            return b
+    return math.ceil(n / 64) * 64
+
+
+def pad_to(x: np.ndarray, target_hw: tuple[int, int], axes: tuple[int, int] = (1, 2)) -> np.ndarray:
+    """Zero-pad spatial axes up to target; reflective padding for conv
+    models would bias borders, zero matches bioimageio tiling convention."""
+    pads = [(0, 0)] * x.ndim
+    for ax, tgt in zip(axes, target_hw):
+        if x.shape[ax] > tgt:
+            raise ValueError(f"axis {ax} size {x.shape[ax]} exceeds bucket {tgt}")
+        pads[ax] = (0, tgt - x.shape[ax])
+    if all(p == (0, 0) for p in pads):
+        return x
+    return np.pad(x, pads)
+
+
+def crop_to(x: np.ndarray, hw: tuple[int, int], axes: tuple[int, int] = (1, 2)) -> np.ndarray:
+    slices = [slice(None)] * x.ndim
+    for ax, tgt in zip(axes, hw):
+        slices[ax] = slice(0, tgt)
+    return x[tuple(slices)]

@@ -1,0 +1,122 @@
+"""torch state_dict -> JAX pytree weight conversion.
+
+The reference executes BioImage Model Zoo weights through torch CUDA
+(ref apps/model-runner/runtime_deployment.py:187-232). Here torch
+checkpoints are converted once into Flax parameter pytrees:
+
+- Conv2d    weight (O, I, kH, kW) -> (kH, kW, I, O); bias unchanged.
+- ConvT2d   weight (I, O, kH, kW) -> (kH, kW, I, O) with spatial flip
+  (torch ConvTranspose correlates with flipped kernels vs flax).
+- Linear    weight (O, I) -> (I, O).
+- LayerNorm/GroupNorm weight/bias -> scale/bias.
+
+``convert_state_dict`` applies these rules mechanically from a name map;
+architecture adapters (e.g. DINOv2 -> bioengine_tpu.models.vit.ViT) own
+the name maps. Tensors arrive as numpy — torch is only required to
+*read* a checkpoint, never at inference time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+
+def conv_kernel(w: np.ndarray) -> np.ndarray:
+    """(O, I, kH, kW) -> (kH, kW, I, O)."""
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+def conv_transpose_kernel(w: np.ndarray) -> np.ndarray:
+    """torch (I, O, kH, kW) -> flax (kH, kW, I, O), spatially flipped."""
+    return np.transpose(w, (2, 3, 0, 1))[::-1, ::-1]
+
+
+def linear_kernel(w: np.ndarray) -> np.ndarray:
+    """(O, I) -> (I, O)."""
+    return np.transpose(w)
+
+
+def load_torch_state_dict(path: str) -> dict[str, np.ndarray]:
+    """Read a torch checkpoint into numpy arrays (CPU, no grad state)."""
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(obj, dict) and "state_dict" in obj:
+        obj = obj["state_dict"]
+    return {k: v.numpy() if hasattr(v, "numpy") else np.asarray(v) for k, v in obj.items()}
+
+
+Rule = tuple[str, Callable[[np.ndarray], np.ndarray]]
+
+
+def convert_state_dict(
+    state_dict: Mapping[str, np.ndarray],
+    name_map: Mapping[str, Rule],
+    strict: bool = True,
+) -> dict[str, Any]:
+    """Convert ``state_dict`` into a nested Flax params dict.
+
+    ``name_map``: torch key -> ("flax/nested/path", transform). Keys in
+    the state dict but not in the map raise under ``strict`` (catches
+    silent architecture drift), otherwise are skipped.
+    """
+    params: dict[str, Any] = {}
+    unmapped = []
+    for tkey, tensor in state_dict.items():
+        if tkey not in name_map:
+            unmapped.append(tkey)
+            continue
+        fpath, transform = name_map[tkey]
+        node = params
+        parts = fpath.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = np.ascontiguousarray(transform(np.asarray(tensor)))
+    if strict and unmapped:
+        raise KeyError(
+            f"{len(unmapped)} torch keys had no mapping, e.g. {unmapped[:5]}"
+        )
+    return params
+
+
+def dinov2_name_map(depth: int = 12) -> dict[str, Rule]:
+    """Name map: DINOv2 torch checkpoint -> bioengine_tpu.models.vit.ViT."""
+    ident = lambda w: w  # noqa: E731
+    m: dict[str, Rule] = {
+        "cls_token": ("cls_token", lambda w: w.reshape(1, 1, -1)),
+        "pos_embed": ("pos_embed", ident),
+        "patch_embed.proj.weight": ("patch_embed/kernel", conv_kernel),
+        "patch_embed.proj.bias": ("patch_embed/bias", ident),
+        "norm.weight": ("norm/scale", ident),
+        "norm.bias": ("norm/bias", ident),
+    }
+    for i in range(depth):
+        t = f"blocks.{i}"
+        f = f"block{i}"
+        m.update(
+            {
+                f"{t}.norm1.weight": (f"{f}/norm1/scale", ident),
+                f"{t}.norm1.bias": (f"{f}/norm1/bias", ident),
+                f"{t}.attn.qkv.weight": (f"{f}/attn/qkv/kernel", linear_kernel),
+                f"{t}.attn.qkv.bias": (f"{f}/attn/qkv/bias", ident),
+                f"{t}.attn.proj.weight": (f"{f}/attn/proj/kernel", linear_kernel),
+                f"{t}.attn.proj.bias": (f"{f}/attn/proj/bias", ident),
+                f"{t}.ls1.gamma": (f"{f}/ls1", ident),
+                f"{t}.ls2.gamma": (f"{f}/ls2", ident),
+                f"{t}.norm2.weight": (f"{f}/norm2/scale", ident),
+                f"{t}.norm2.bias": (f"{f}/norm2/bias", ident),
+                f"{t}.mlp.fc1.weight": (f"{f}/mlp/Dense_0/kernel", linear_kernel),
+                f"{t}.mlp.fc1.bias": (f"{f}/mlp/Dense_0/bias", ident),
+                f"{t}.mlp.fc2.weight": (f"{f}/mlp/Dense_1/kernel", linear_kernel),
+                f"{t}.mlp.fc2.bias": (f"{f}/mlp/Dense_1/bias", ident),
+            }
+        )
+    return m
+
+
+def count_params(params: Any) -> int:
+    import jax
+
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))

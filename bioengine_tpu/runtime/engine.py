@@ -1,0 +1,171 @@
+"""The XLA inference engine.
+
+Replaces the reference's prediction pipeline (ref apps/model-runner/
+runtime_deployment.py:234-312: bioimageio.core torch pipeline, CUDA-OOM
+normalization, optional blockwise/tiled prediction) with a TPU design:
+
+request -> shape bucket -> compiled-program cache -> padded batch on
+device -> jitted forward -> crop back. Images larger than ``max_tile``
+run tiled with overlap and linear blend stitching (the reference's
+blockwise path, but vectorized: all tiles form one batch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bioengine_tpu.runtime.buckets import (
+    DEFAULT_LADDER,
+    bucket_batch,
+    bucket_shape,
+    crop_to,
+    pad_to,
+)
+from bioengine_tpu.runtime.program_cache import (
+    CompiledProgramCache,
+    default_program_cache,
+)
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    max_tile: int = 1024          # images above this tile-and-stitch
+    tile: int = 512
+    tile_overlap: int = 64
+    ladder: tuple = DEFAULT_LADDER
+
+
+class InferenceEngine:
+    """Wraps one model (apply_fn + params) behind bucketed jit programs.
+
+    ``apply_fn(params, images)``: (B, H, W, C) -> (B, H, W, C_out), i.e.
+    dense spatial outputs. Global-output models (embedders returning
+    (B, D)) must be fed exact-bucket-sized inputs — zero-padding would
+    silently change a global embedding, so the engine raises instead
+    (embedding workloads resize crops to a fixed size anyway, ref
+    apps/cell-image-search/embedder.py uses fixed 224x224).
+
+    Engine instances are cheap; compiled programs live in the (shared)
+    CompiledProgramCache keyed by (model_id, B, H, W, C, dtype).
+    """
+
+    def __init__(
+        self,
+        model_id: str,
+        apply_fn: Callable[[Any, jax.Array], jax.Array],
+        params: Any,
+        divisor: int = 1,
+        config: Optional[EngineConfig] = None,
+        cache: Optional[CompiledProgramCache] = None,
+        device: Optional[jax.Device] = None,
+    ):
+        self.model_id = model_id
+        self.apply_fn = apply_fn
+        self.divisor = divisor
+        self.config = config or EngineConfig()
+        self.cache = cache if cache is not None else default_program_cache
+        self.device = device or jax.devices()[0]
+        self.params = jax.device_put(params, self.device)
+
+    # ---- program management -------------------------------------------------
+
+    def _program(self, batch: int, h: int, w: int, c: int, dtype) -> Callable:
+        key = (self.model_id, batch, h, w, c, np.dtype(dtype).name)
+
+        def build():
+            fn = jax.jit(self.apply_fn)
+            # Trigger compilation now so the first request doesn't pay it
+            # inside the hot path accounting.
+            dummy = jnp.zeros((batch, h, w, c), dtype)
+            fn(self.params, dummy).block_until_ready()
+            return fn
+
+        return self.cache.get_or_compile(key, build)
+
+    def warmup(self, shapes: list[tuple[int, int, int, int]], dtype=np.float32):
+        for b, h, w, c in shapes:
+            self._program(b, h, w, c, dtype)
+
+    # ---- prediction ---------------------------------------------------------
+
+    def predict(self, images: np.ndarray) -> np.ndarray:
+        """images: (B, H, W, C) host array -> model output, original size."""
+        images = np.asarray(images)
+        if images.ndim != 4:
+            raise ValueError(f"expected (B, H, W, C), got {images.shape}")
+        B, H, W, C = images.shape
+        if max(H, W) > self.config.max_tile:
+            return np.stack([self._predict_tiled(img) for img in images])
+        bh, bw = bucket_shape((H, W), self.config.ladder, self.divisor)
+        bb = bucket_batch(B)
+        x = pad_to(images, (bh, bw))
+        if bb != B:
+            x = np.concatenate([x, np.zeros((bb - B, bh, bw, C), x.dtype)])
+        program = self._program(bb, bh, bw, C, x.dtype)
+        out = np.asarray(program(self.params, jax.device_put(x, self.device)))
+        out = out[:B]
+        if out.ndim == 4:
+            out = crop_to(out, (H, W))
+        elif (bh, bw) != (H, W):
+            raise ValueError(
+                f"model '{self.model_id}' returns a global output "
+                f"(shape {out.shape}) but the input {(H, W)} was padded to "
+                f"bucket {(bh, bw)} — padding corrupts global outputs. "
+                f"Resize inputs to a bucket size ({self.config.ladder})."
+            )
+        return out
+
+    def _predict_tiled(self, image: np.ndarray) -> np.ndarray:
+        """Overlap-tile a single (H, W, C) image; all tiles in one batch.
+
+        Linear-ramp blending in the overlap bands (the reference's
+        Gaussian-blend stitching, ref apps/fibsem-mito-analysis/
+        analysis_deployment.py:10-14, with a separable ramp).
+        """
+        t, ov = self.config.tile, self.config.tile_overlap
+        H, W, C = image.shape
+        stride = t - ov
+        ys = list(range(0, max(H - ov, 1), stride))
+        xs = list(range(0, max(W - ov, 1), stride))
+        tiles, coords = [], []
+        for y in ys:
+            for x in xs:
+                y0, x0 = min(y, max(H - t, 0)), min(x, max(W - t, 0))
+                tile = image[y0 : y0 + t, x0 : x0 + t]
+                tile = pad_to(tile[None], (t, t))[0]
+                tiles.append(tile)
+                coords.append((y0, x0))
+        batch = np.stack(tiles)
+        out_tiles = self.predict(batch)  # recurses into bucketed path
+        if out_tiles.ndim != 4:
+            raise ValueError(
+                f"tiled prediction requires dense (B, H, W, C) outputs, "
+                f"model '{self.model_id}' returned {out_tiles.shape}"
+            )
+        c_out = out_tiles.shape[-1]
+        acc = np.zeros((H, W, c_out), np.float32)
+        weight = np.zeros((H, W, 1), np.float32)
+        ramp = _blend_ramp(t, ov)
+        for tile_out, (y0, x0) in zip(out_tiles, coords):
+            h = min(t, H - y0)
+            w = min(t, W - x0)
+            acc[y0 : y0 + h, x0 : x0 + w] += (
+                tile_out[:h, :w] * ramp[:h, :w]
+            )
+            weight[y0 : y0 + h, x0 : x0 + w] += ramp[:h, :w]
+        return acc / np.maximum(weight, 1e-8)
+
+
+def _blend_ramp(tile: int, overlap: int) -> np.ndarray:
+    """Separable linear ramp (tile, tile, 1), 1.0 in the interior."""
+    r = np.ones(tile, np.float32)
+    if overlap > 0:
+        edge = np.linspace(1.0 / (overlap + 1), 1.0, overlap, dtype=np.float32)
+        r[:overlap] = edge
+        r[-overlap:] = edge[::-1]
+    return (r[:, None] * r[None, :])[..., None]

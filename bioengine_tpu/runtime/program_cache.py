@@ -1,0 +1,112 @@
+"""Compiled-program cache — the TPU equivalent of the reference's
+multiplexed prediction-pipeline cache (ref apps/model-runner/
+runtime_deployment.py:160-232, which LRU-caches torch pipelines keyed on
+an md5 of model kwargs).
+
+Here the cached object is an XLA executable: ``jit(fn)`` lowered and
+compiled for a concrete (shape-bucket, dtype, mesh) key. Keys are
+explicit so eviction, stats, and warm-up are controllable — unlike
+jax's implicit compilation cache, whose entries can't be enumerated or
+evicted per-model.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Hashable, Optional
+
+
+@dataclass
+class CacheStats:
+    hits: int = 0
+    misses: int = 0
+    evictions: int = 0
+    compile_seconds: dict = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        total = self.hits + self.misses
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "hit_rate": self.hits / total if total else 0.0,
+            "total_compile_seconds": sum(self.compile_seconds.values()),
+        }
+
+
+class CompiledProgramCache:
+    """Bounded LRU of compiled XLA programs.
+
+    ``get_or_compile(key, build)`` — ``build()`` must return the callable
+    to cache (typically ``jax.jit(fn).lower(*args).compile()`` or a plain
+    jitted fn). Thread-safe: concurrent misses on the same key compile
+    once; other callers wait.
+    """
+
+    def __init__(self, max_programs: int = 32):
+        self.max_programs = max_programs
+        self._programs: OrderedDict[Hashable, Any] = OrderedDict()
+        self._building: dict[Hashable, threading.Event] = {}
+        self._lock = threading.Lock()
+        self.stats = CacheStats()
+
+    def get_or_compile(self, key: Hashable, build: Callable[[], Any]) -> Any:
+        while True:
+            with self._lock:
+                if key in self._programs:
+                    self._programs.move_to_end(key)
+                    self.stats.hits += 1
+                    return self._programs[key]
+                ev = self._building.get(key)
+                if ev is None:
+                    self._building[key] = threading.Event()
+                    break
+            ev.wait()
+        try:
+            t0 = time.perf_counter()
+            program = build()
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.stats.misses += 1
+                self.stats.compile_seconds[str(key)] = dt
+                self._programs[key] = program
+                self._programs.move_to_end(key)
+                while len(self._programs) > self.max_programs:
+                    self._programs.popitem(last=False)
+                    self.stats.evictions += 1
+            return program
+        finally:
+            with self._lock:
+                self._building.pop(key).set()
+
+    def get(self, key: Hashable) -> Optional[Any]:
+        with self._lock:
+            if key in self._programs:
+                self._programs.move_to_end(key)
+                self.stats.hits += 1
+                return self._programs[key]
+        return None
+
+    def evict(self, predicate: Callable[[Hashable], bool]) -> int:
+        """Evict all entries whose key matches (e.g. one model's programs)."""
+        with self._lock:
+            victims = [k for k in self._programs if predicate(k)]
+            for k in victims:
+                del self._programs[k]
+            self.stats.evictions += len(victims)
+            return len(victims)
+
+    def keys(self) -> list[Hashable]:
+        with self._lock:
+            return list(self._programs)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._programs)
+
+
+# Process-wide default, shared by inference engines in one replica.
+default_program_cache = CompiledProgramCache()

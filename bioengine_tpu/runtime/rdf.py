@@ -1,0 +1,158 @@
+"""Minimal BioImage Model Zoo RDF (resource description file) support.
+
+The reference leans on the bioimageio.core + bioimageio.spec packages to
+parse model RDFs and build torch prediction pipelines (ref
+apps/model-runner/runtime_deployment.py:86-232). Those packages are not
+part of this image, and most of what model serving needs is small: axes
+bookkeeping, pre-/post-processing ops, and weight-source selection. This
+module implements exactly that subset over plain YAML, for spec 0.4/0.5
+model RDFs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any, Optional
+
+import numpy as np
+import yaml
+
+
+@dataclasses.dataclass
+class TensorSpec:
+    name: str
+    axes: str                      # canonical string like "bcyx" / "byxc"
+    preprocessing: list[dict]
+    postprocessing: list[dict]
+    data_range: Optional[tuple] = None
+
+
+@dataclasses.dataclass
+class ModelRDF:
+    name: str
+    rdf_id: Optional[str]
+    description: str
+    inputs: list[TensorSpec]
+    outputs: list[TensorSpec]
+    weights: dict[str, dict]       # format -> {"source": ..., ...}
+    raw: dict
+
+    @property
+    def preferred_weights(self) -> tuple[str, dict]:
+        """Preference order for the TPU path: state dicts convert to JAX;
+        torchscript/onnx fall back to host torch execution."""
+        for fmt in ("pytorch_state_dict", "torchscript", "onnx"):
+            if fmt in self.weights:
+                return fmt, self.weights[fmt]
+        if self.weights:
+            return next(iter(self.weights.items()))
+        raise ValueError(f"Model '{self.name}' has no weight entries")
+
+
+def _axes_string(axes: Any) -> str:
+    """Normalize spec-0.5 axis dicts or 0.4 strings to a char string."""
+    if isinstance(axes, str):
+        return axes
+    chars = []
+    for ax in axes:
+        if isinstance(ax, dict):
+            t = ax.get("type", ax.get("id", "?"))
+            chars.append(
+                {"batch": "b", "channel": "c", "space": ax.get("id", "x")}.get(
+                    t, str(ax.get("id", "?"))[0]
+                )
+            )
+        else:
+            chars.append(str(ax)[0])
+    return "".join(chars)
+
+
+def _tensor_spec(entry: dict) -> TensorSpec:
+    return TensorSpec(
+        name=str(entry.get("name", entry.get("id", "tensor"))),
+        axes=_axes_string(entry.get("axes", "bcyx")),
+        preprocessing=list(entry.get("preprocessing", []) or []),
+        postprocessing=list(entry.get("postprocessing", []) or []),
+    )
+
+
+def load_model_rdf(source: str | Path | dict) -> ModelRDF:
+    if isinstance(source, (str, Path)):
+        raw = yaml.safe_load(Path(source).read_text())
+    else:
+        raw = dict(source)
+    if raw.get("type") not in (None, "model"):
+        raise ValueError(f"Not a model RDF (type={raw.get('type')})")
+    return ModelRDF(
+        name=raw.get("name", "unnamed-model"),
+        rdf_id=raw.get("id"),
+        description=raw.get("description", ""),
+        inputs=[_tensor_spec(e) for e in raw.get("inputs", [])],
+        outputs=[_tensor_spec(e) for e in raw.get("outputs", [])],
+        weights={k: dict(v or {}) for k, v in (raw.get("weights") or {}).items()},
+        raw=raw,
+    )
+
+
+# ---- axes conversion --------------------------------------------------------
+
+def to_nhwc(x: np.ndarray, axes: str) -> np.ndarray:
+    """Rearrange an array described by an RDF axes string into (B,H,W,C)."""
+    axes = axes.lower()
+    x = np.asarray(x)
+    if x.ndim != len(axes):
+        if x.ndim == len(axes) - 1 and "b" in axes:
+            x = x[None]
+        else:
+            raise ValueError(f"array ndim {x.ndim} != axes '{axes}'")
+    order = [axes.index(a) for a in "byxc" if a in axes]
+    missing = [a for a in "byxc" if a not in axes]
+    x = np.transpose(x, order + [i for i in range(len(axes)) if i not in order])
+    for a in missing:
+        x = np.expand_dims(x, "byxc".index(a) if a != "c" else -1)
+    return x
+
+
+def from_nhwc(x: np.ndarray, axes: str) -> np.ndarray:
+    """Inverse of to_nhwc for the model-output round trip."""
+    axes = axes.lower()
+    present = [a for a in "byxc" if a in axes]
+    # drop axes the target doesn't have (singleton only)
+    for i, a in reversed(list(enumerate("byxc"))):
+        if a not in axes:
+            x = np.squeeze(x, axis=i if a != "c" else -1)
+    inv = [present.index(a) for a in axes if a in present]
+    return np.transpose(x, inv)
+
+
+# ---- pre/post-processing ops ------------------------------------------------
+
+def apply_processing(x: np.ndarray, ops: list[dict]) -> np.ndarray:
+    """Apply RDF pre-/post-processing ops (numpy, NHWC layout)."""
+    for op in ops:
+        name = op.get("name", op.get("id"))
+        kw = op.get("kwargs", {}) or {}
+        if name in ("zero_mean_unit_variance", "fixed_zero_mean_unit_variance"):
+            mean = kw.get("mean")
+            std = kw.get("std")
+            if mean is None:
+                axes = tuple(range(x.ndim - 1)) if kw.get("mode") != "per_sample" else tuple(range(1, x.ndim))
+                mean = x.mean(axis=axes, keepdims=True)
+                std = x.std(axis=axes, keepdims=True)
+            x = (x - np.asarray(mean)) / (np.asarray(std) + kw.get("eps", 1e-6))
+        elif name == "scale_range":
+            lo = np.percentile(x, kw.get("min_percentile", 0.0))
+            hi = np.percentile(x, kw.get("max_percentile", 100.0))
+            x = (x - lo) / max(hi - lo, kw.get("eps", 1e-6))
+        elif name == "scale_linear":
+            x = x * np.asarray(kw.get("gain", 1.0)) + np.asarray(kw.get("offset", 0.0))
+        elif name == "sigmoid":
+            x = 1.0 / (1.0 + np.exp(-x))
+        elif name == "binarize":
+            x = (x > kw.get("threshold", 0.5)).astype(np.float32)
+        elif name == "clip":
+            x = np.clip(x, kw.get("min"), kw.get("max"))
+        else:
+            raise NotImplementedError(f"processing op '{name}'")
+    return x.astype(np.float32)

@@ -1,0 +1,57 @@
+"""Host-torch fallback for Model Zoo models that don't convert to JAX.
+
+SURVEY.md §7 "Hard parts": weight conversion for *arbitrary* zoo
+architectures can't be guaranteed; the pragmatic fallback keeps those
+models runnable behind the same engine interface. On a TPU VM this path
+can route through torch-xla when present; otherwise it executes on the
+host CPU (torch in this image is CPU-only) — correct, just not fast.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def torch_available() -> bool:
+    try:
+        import torch  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+class TorchFallbackRunner:
+    """predict(NHWC numpy) -> NHWC numpy via a torchscript/state-dict model."""
+
+    def __init__(self, module=None, torchscript_path: Optional[str] = None):
+        import torch
+
+        self._torch = torch
+        if module is None:
+            if torchscript_path is None:
+                raise ValueError("need a module or a torchscript path")
+            module = torch.jit.load(torchscript_path, map_location="cpu")
+        self.module = module.eval()
+        self.device = self._pick_device()
+        self.module.to(self.device)
+
+    def _pick_device(self):
+        torch = self._torch
+        try:
+            import torch_xla.core.xla_model as xm  # type: ignore
+
+            return xm.xla_device()
+        except ImportError:
+            return torch.device("cpu")
+
+    def predict(self, images: np.ndarray) -> np.ndarray:
+        torch = self._torch
+        x = torch.from_numpy(np.ascontiguousarray(images)).permute(0, 3, 1, 2)
+        with torch.no_grad():
+            y = self.module(x.to(self.device))
+        if isinstance(y, (list, tuple)):
+            y = y[0]
+        return y.detach().cpu().permute(0, 2, 3, 1).numpy()

@@ -21,6 +21,12 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# Some environments install a remote-TPU PJRT plugin from sitecustomize at
+# interpreter startup and overwrite the jax_platforms config, ignoring
+# JAX_PLATFORMS. Force pure-CPU here (before any backend is initialized)
+# so the suite never blocks on remote hardware.
+jax.config.update("jax_platforms", "cpu")
+
 
 @pytest.fixture(scope="session")
 def devices():

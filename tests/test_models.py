@@ -1,0 +1,64 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bioengine_tpu.models import get_model, list_models
+from bioengine_tpu.models.cellpose import (
+    CellposeConfig,
+    cellpose_loss,
+    create_model_and_state,
+    make_train_step,
+)
+
+pytestmark = pytest.mark.unit
+
+
+def test_registry_lists_builtins():
+    models = list_models()
+    assert {"unet2d", "cellpose", "vit-b14", "vit-s14"} <= set(models)
+    with pytest.raises(KeyError):
+        get_model("no-such-model")
+
+
+def test_unet_shapes():
+    model = get_model("unet2d", features=(8, 16, 32), out_channels=2)
+    x = jnp.zeros((2, 64, 64, 1))
+    params = model.init(jax.random.key(0), x)["params"]
+    y = model.apply({"params": params}, x)
+    assert y.shape == (2, 64, 64, 2)
+    assert y.dtype == jnp.float32
+
+
+def test_vit_embedding_shape():
+    model = get_model("vit-s14", depth=2, dim=64, num_heads=4)
+    x = jnp.zeros((2, 28, 28, 3))
+    params = model.init(jax.random.key(0), x)["params"]
+    emb = model.apply({"params": params}, x)
+    assert emb.shape == (2, 64)
+    assert emb.dtype == jnp.float32
+
+
+def test_cellpose_forward_and_train_step_reduces_loss():
+    cfg = CellposeConfig(features=(8, 16, 32), learning_rate=1e-2)
+    model, state = create_model_and_state(cfg, jax.random.key(0), (32, 32))
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.normal(size=(2, 32, 32, 2)), jnp.float32)
+    flows = jnp.zeros((2, 32, 32, 2))
+    cellprob = jnp.zeros((2, 32, 32))
+
+    step = jax.jit(make_train_step())
+    state, m0 = step(state, images, flows, cellprob)
+    for _ in range(5):
+        state, m = step(state, images, flows, cellprob)
+    assert float(m["loss"]) < float(m0["loss"])
+    assert int(state.step) == 6
+
+
+def test_cellpose_loss_components():
+    pred = jnp.zeros((1, 8, 8, 3))
+    flows = jnp.ones((1, 8, 8, 2)) * 0.2
+    cellprob = jnp.ones((1, 8, 8))
+    loss, parts = cellpose_loss(pred, flows, cellprob)
+    assert float(loss) > 0
+    assert set(parts) == {"flow_loss", "bce_loss"}
