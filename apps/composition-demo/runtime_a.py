@@ -1,0 +1,3 @@
+class RuntimeA:
+    async def transform(self, value):
+        return value * 2

@@ -1,0 +1,3 @@
+class RuntimeB:
+    async def transform(self, value):
+        return value + 100

@@ -1,0 +1,14 @@
+from bioengine_tpu.apps.artifacts import LocalArtifactStore
+from bioengine_tpu.apps.builder import AppBuilder, BuiltApp
+from bioengine_tpu.apps.manager import AppsManager
+from bioengine_tpu.apps.manifest import AppManifest, load_manifest, validate_manifest
+
+__all__ = [
+    "LocalArtifactStore",
+    "AppBuilder",
+    "BuiltApp",
+    "AppsManager",
+    "AppManifest",
+    "load_manifest",
+    "validate_manifest",
+]

@@ -1,0 +1,414 @@
+"""AppsManager — the deploy/update/undeploy lifecycle owner.
+
+Parity surface with the reference's AppsManager (ref bioengine/apps/
+manager.py): ``deploy_app`` under a deployment lock with generated
+two-word app ids (:203-237), resource-fit pre-check with scale-out
+allowance (:239-353), ``stop_app``/``stop_all_apps``, artifact CRUD
+(``upload_app``/``list_apps``/``get_app_manifest``/``delete_app``,
+:1073-1467), app-dir listing/cleanup (:1184-1304), status aggregation
+with per-replica (incl. dead) logs and masked ``_``-secret env keys
+(:560-773), auto-redeploy monitoring (:1003-1071), and startup apps
+(:937-1001).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import shutil
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+from bioengine_tpu.apps.artifacts import LocalArtifactStore
+from bioengine_tpu.apps.builder import AppBuilder, BuiltApp
+from bioengine_tpu.apps.proxy import AppServiceProxy
+from bioengine_tpu.rpc.server import RpcServer
+from bioengine_tpu.serving.controller import DeploymentHandle, ServeController
+from bioengine_tpu.utils.logger import create_logger
+from bioengine_tpu.utils.permissions import check_permissions
+
+_ADJECTIVES = (
+    "amber", "brisk", "calm", "deft", "eager", "fuzzy", "gold", "hazy",
+    "icy", "jolly", "keen", "lucid", "mellow", "noble", "opal", "prime",
+    "quiet", "rapid", "solar", "tidal", "umber", "vivid", "warm", "zesty",
+)
+_NOUNS = (
+    "axon", "basil", "comet", "delta", "ember", "fjord", "glade", "harbor",
+    "iris", "jade", "krill", "lotus", "meadow", "nectar", "orchid", "pine",
+    "quartz", "reef", "sprout", "thistle", "urchin", "vortex", "willow", "zephyr",
+)
+
+
+@dataclass
+class AppRecord:
+    app_id: str
+    built: BuiltApp
+    proxy: AppServiceProxy
+    artifact_id: Optional[str]
+    version: Optional[str]
+    local_path: Optional[str]
+    deployed_by: str
+    deployed_at: float = field(default_factory=time.time)
+    auto_redeploy: bool = False
+    env_keys: list[str] = field(default_factory=list)
+    deployment_kwargs: dict = field(default_factory=dict)
+    # stored verbatim so auto-redeploy reproduces the ORIGINAL deploy
+    # call — without these, a restart would silently fall back to the
+    # manifest's ACL and lose env overrides
+    authorized_users: Optional[list[str]] = None
+    env_vars: dict = field(default_factory=dict)
+    redeploy_count: int = 0
+
+
+class AppsManager:
+    def __init__(
+        self,
+        controller: ServeController,
+        server: RpcServer,
+        store: Optional[LocalArtifactStore] = None,
+        builder: Optional[AppBuilder] = None,
+        admin_users: Optional[list[str]] = None,
+        can_scale_out: bool = False,
+        max_auto_redeploys: int = 3,
+        log_file: Optional[str] = None,
+    ):
+        self.controller = controller
+        self.server = server
+        self.store = store
+        self.builder = builder or AppBuilder(
+            store=store, admin_users=admin_users
+        )
+        self.admin_users = list(admin_users or [])
+        self.can_scale_out = can_scale_out
+        self.max_auto_redeploys = max_auto_redeploys
+        self.records: dict[str, AppRecord] = {}
+        self.logger = create_logger("apps.manager", log_file=log_file)
+        self._deploy_lock = asyncio.Lock()
+
+    # ---- id generation ------------------------------------------------------
+
+    def _generate_app_id(self) -> str:
+        for _ in range(100):
+            app_id = (
+                f"{random.choice(_ADJECTIVES)}-{random.choice(_NOUNS)}"
+            )
+            if app_id not in self.records:
+                return app_id
+        return f"app-{random.getrandbits(32):08x}"
+
+    # ---- resource pre-check -------------------------------------------------
+
+    def _check_resources(self, built: BuiltApp) -> None:
+        """Fail fast when the app can never fit; in scalable modes a
+        shortfall is allowed (the provisioner will add capacity), same
+        allowance as ref manager.py:239-353."""
+        needed = sum(
+            s.chips_per_replica * s.num_replicas for s in built.specs
+        )
+        total = self.controller.cluster_state.topology.n_chips
+        free = self.controller.cluster_state.free_chips()
+        if needed > free and not self.can_scale_out:
+            raise RuntimeError(
+                f"app needs {needed} chips, only {free}/{total} free and "
+                f"this cluster mode cannot scale out"
+            )
+
+    # ---- deploy / stop ------------------------------------------------------
+
+    async def deploy_app(
+        self,
+        artifact_id: Optional[str] = None,
+        version: Optional[str] = None,
+        local_path: Optional[str] = None,
+        app_id: Optional[str] = None,
+        deployment_kwargs: Optional[dict] = None,
+        env_vars: Optional[dict] = None,
+        authorized_users: Optional[list[str]] = None,
+        auto_redeploy: bool = False,
+        context: Optional[dict] = None,
+    ) -> dict:
+        check_permissions(context, self.admin_users, "deploy_app")
+        async with self._deploy_lock:
+            is_update = app_id is not None and app_id in self.records
+            if is_update:
+                await self._undeploy(app_id)
+            app_id = app_id or self._generate_app_id()
+            deployer = (context or {}).get("user", {}).get("id", "unknown")
+
+            built = self.builder.build(
+                app_id=app_id,
+                artifact_id=artifact_id,
+                version=version,
+                local_path=local_path,
+                deployment_kwargs=deployment_kwargs,
+                env_vars=env_vars,
+                authorized_users_override=authorized_users,
+                make_handle=lambda name, a=app_id: DeploymentHandle(
+                    self.controller, a, name
+                ),
+                deployer=deployer,
+            )
+            self._check_resources(built)
+            await self.controller.deploy(app_id, built.specs)
+            proxy = AppServiceProxy(self.server, self.controller, built)
+            proxy.register()
+            self.records[app_id] = AppRecord(
+                app_id=app_id,
+                built=built,
+                proxy=proxy,
+                artifact_id=artifact_id,
+                version=version,
+                local_path=str(local_path) if local_path else None,
+                deployed_by=deployer,
+                auto_redeploy=auto_redeploy,
+                env_keys=sorted(env_vars or {}),
+                deployment_kwargs=dict(deployment_kwargs or {}),
+                authorized_users=(
+                    list(authorized_users) if authorized_users is not None else None
+                ),
+                env_vars=dict(env_vars or {}),
+            )
+            self.logger.info(
+                f"deployed '{app_id}' ({built.manifest.name}) "
+                f"by {deployer}"
+            )
+            return {
+                "app_id": app_id,
+                "service_id": proxy.service_id,
+                "name": built.manifest.name,
+                "methods": sorted(built.schema_methods),
+            }
+
+    async def _undeploy(self, app_id: str) -> None:
+        record = self.records.pop(app_id, None)
+        if record is None:
+            return
+        record.proxy.deregister()
+        await self.controller.undeploy(app_id)
+
+    async def stop_app(self, app_id: str, context: Optional[dict] = None) -> dict:
+        check_permissions(context, self.admin_users, "stop_app")
+        if app_id not in self.records:
+            raise KeyError(f"app '{app_id}' is not deployed")
+        async with self._deploy_lock:
+            await self._undeploy(app_id)
+        return {"app_id": app_id, "status": "STOPPED"}
+
+    async def stop_all_apps(self, context: Optional[dict] = None) -> list[str]:
+        check_permissions(context, self.admin_users, "stop_all_apps")
+        async with self._deploy_lock:
+            stopped = list(self.records)
+            for app_id in stopped:
+                await self._undeploy(app_id)
+        return stopped
+
+    # ---- status -------------------------------------------------------------
+
+    def get_app_status(
+        self, app_id: Optional[str] = None, context: Optional[dict] = None
+    ) -> dict:
+        if app_id is not None:
+            return self._one_status(app_id)
+        return {aid: self._one_status(aid) for aid in self.records}
+
+    def _one_status(self, app_id: str) -> dict:
+        record = self.records.get(app_id)
+        if record is None:
+            raise KeyError(f"app '{app_id}' is not deployed")
+        status = self.controller.get_app_status(app_id)
+        status.update(
+            {
+                "name": record.built.manifest.name,
+                "id_emoji": record.built.manifest.id_emoji,
+                "artifact_id": record.artifact_id,
+                "version": record.version,
+                "deployed_by": record.deployed_by,
+                "deployed_at": record.deployed_at,
+                "service_id": record.proxy.service_id,
+                "available_methods": sorted(record.built.schema_methods),
+                "authorized_users": record.built.authorized_users,
+                # secret convention: only names, never values
+                "env_keys": [
+                    k if not k.startswith("_") else f"{k} (masked)"
+                    for k in record.env_keys
+                ],
+                "auto_redeploy": record.auto_redeploy,
+                "replica_logs": self.controller.cluster_state.get_replica_logs(
+                    app_id
+                ),
+            }
+        )
+        return status
+
+    def list_apps(self, context: Optional[dict] = None) -> list[dict]:
+        check_permissions(context, self.admin_users, "list_apps")
+        if self.store is None:
+            return []
+        out = []
+        for artifact_id in self.store.list_artifacts():
+            manifest = self.store.get_manifest(artifact_id)
+            out.append(
+                {
+                    "artifact_id": artifact_id,
+                    "name": manifest.name,
+                    "description": manifest.description,
+                    "versions": self.store.versions(artifact_id),
+                    "latest": self.store.latest_version(artifact_id),
+                }
+            )
+        return out
+
+    # ---- artifact CRUD ------------------------------------------------------
+
+    def upload_app(
+        self,
+        src_dir: str,
+        artifact_id: Optional[str] = None,
+        version: Optional[str] = None,
+        context: Optional[dict] = None,
+    ) -> dict:
+        check_permissions(context, self.admin_users, "upload_app")
+        if self.store is None:
+            raise RuntimeError("no artifact store configured")
+        aid, ver = self.store.put(src_dir, artifact_id, version)
+        return {"artifact_id": aid, "version": ver}
+
+    def get_app_manifest(
+        self,
+        artifact_id: str,
+        version: Optional[str] = None,
+        context: Optional[dict] = None,
+    ) -> dict:
+        check_permissions(context, self.admin_users, "get_app_manifest")
+        if self.store is None:
+            raise RuntimeError("no artifact store configured")
+        return self.store.get_manifest(artifact_id, version).raw
+
+    def delete_app(
+        self,
+        artifact_id: str,
+        version: Optional[str] = None,
+        context: Optional[dict] = None,
+    ) -> dict:
+        check_permissions(context, self.admin_users, "delete_app")
+        if self.store is None:
+            raise RuntimeError("no artifact store configured")
+        self.store.delete(artifact_id, version)
+        return {"artifact_id": artifact_id, "deleted": True}
+
+    # ---- app workdir management --------------------------------------------
+
+    def list_app_directories(self, context: Optional[dict] = None) -> list[dict]:
+        check_permissions(context, self.admin_users, "list_app_directories")
+        root = self.builder.workdir_root
+        if not root.exists():
+            return []
+        out = []
+        for d in sorted(p for p in root.iterdir() if p.is_dir()):
+            size = sum(f.stat().st_size for f in d.rglob("*") if f.is_file())
+            out.append(
+                {
+                    "app_id": d.name,
+                    "size_bytes": size,
+                    "in_use": d.name in self.records,
+                }
+            )
+        return out
+
+    def clear_app_directory(
+        self, app_id: str, context: Optional[dict] = None
+    ) -> dict:
+        check_permissions(context, self.admin_users, "clear_app_directory")
+        if app_id in self.records:
+            raise RuntimeError(f"app '{app_id}' is deployed; stop it first")
+        target = self.builder.workdir_root / app_id
+        if target.exists():
+            shutil.rmtree(target)
+            return {"app_id": app_id, "cleared": True}
+        return {"app_id": app_id, "cleared": False}
+
+    # ---- monitoring / recovery ----------------------------------------------
+
+    async def monitor_applications(self) -> None:
+        """One monitor pass: redeploy apps that went UNHEALTHY or
+        DEPLOY_FAILED when auto_redeploy is set (ref manager.py:1003-1071);
+        keep service registration in sync with health."""
+        for app_id, record in list(self.records.items()):
+            app = self.controller.apps.get(app_id)
+            status = app.status if app else "DEPLOY_FAILED"
+            if status == "RUNNING":
+                if not record.proxy.registered:
+                    record.proxy.register()
+                continue
+            if status == "UNHEALTHY" and record.proxy.registered:
+                # drop the public service the moment the app is bad
+                record.proxy.deregister()
+            if (
+                status in ("UNHEALTHY", "DEPLOY_FAILED")
+                and record.auto_redeploy
+                and record.redeploy_count < self.max_auto_redeploys
+            ):
+                record.redeploy_count += 1
+                self.logger.warning(
+                    f"auto-redeploying '{app_id}' "
+                    f"(attempt {record.redeploy_count})"
+                )
+                admin_ctx = {
+                    "user": {"id": self.admin_users[0] if self.admin_users else "system"},
+                    "ws": "bioengine",
+                }
+                try:
+                    await self.deploy_app(
+                        artifact_id=record.artifact_id,
+                        version=record.version,
+                        local_path=record.local_path,
+                        app_id=app_id,
+                        deployment_kwargs=record.deployment_kwargs,
+                        env_vars=record.env_vars,
+                        authorized_users=record.authorized_users,
+                        auto_redeploy=True,
+                        context=admin_ctx,
+                    )
+                    self.records[app_id].redeploy_count = record.redeploy_count
+                except Exception as e:
+                    self.logger.error(f"auto-redeploy of '{app_id}' failed: {e}")
+
+    async def deploy_startup_applications(
+        self, startup_applications: list[dict]
+    ) -> list[dict]:
+        """Deploy the configured startup apps with admin context
+        (ref manager.py:937-1001)."""
+        admin_ctx = {
+            "user": {"id": self.admin_users[0] if self.admin_users else "system"},
+            "ws": "bioengine",
+        }
+        results = []
+        for app_config in startup_applications:
+            try:
+                results.append(
+                    await self.deploy_app(**app_config, context=admin_ctx)
+                )
+            except Exception as e:
+                self.logger.error(
+                    f"startup app {app_config} failed to deploy: {e}"
+                )
+                results.append({"error": str(e), "config": app_config})
+        return results
+
+    # ---- service surface ----------------------------------------------------
+
+    def service_methods(self) -> dict[str, Any]:
+        return {
+            "deploy_app": self.deploy_app,
+            "stop_app": self.stop_app,
+            "stop_all_apps": self.stop_all_apps,
+            "get_app_status": self.get_app_status,
+            "list_apps": self.list_apps,
+            "upload_app": self.upload_app,
+            "get_app_manifest": self.get_app_manifest,
+            "delete_app": self.delete_app,
+            "list_app_directories": self.list_app_directories,
+            "clear_app_directory": self.clear_app_directory,
+        }

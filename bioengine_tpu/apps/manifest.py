@@ -1,0 +1,104 @@
+"""App manifest schema + validation.
+
+Same manifest contract as the reference so existing app directories port
+unchanged (ref bioengine/apps/builder.py:29-67: required name/id/
+id_emoji/description/type/deployments, optional frontend_entry;
+``deployments`` entries are "file_stem:ClassName"). The TPU build adds
+optional per-deployment resource hints (``deployment_config``) including
+a mesh spec.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from pathlib import Path
+from typing import Any, Optional
+
+import yaml
+
+REQUIRED_FIELDS = ("name", "id", "id_emoji", "description", "type", "deployments")
+# accept the reference's type string so existing manifests work verbatim
+ACCEPTED_TYPES = ("tpu-serve", "ray-serve")
+
+_DEPLOYMENT_RE = re.compile(r"^([A-Za-z_][\w\-/]*):([A-Za-z_]\w*)$")
+
+
+class ManifestError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class DeploymentRef:
+    file_stem: str
+    class_name: str
+
+    @property
+    def python_file(self) -> str:
+        return f"{self.file_stem}.py"
+
+
+@dataclasses.dataclass
+class AppManifest:
+    name: str
+    id: str
+    id_emoji: str
+    description: str
+    type: str
+    deployments: list[DeploymentRef]
+    version: str = "1.0.0"
+    frontend_entry: Optional[str] = None
+    authorized_users: list[str] = dataclasses.field(default_factory=list)
+    deployment_config: dict[str, dict] = dataclasses.field(default_factory=dict)
+    raw: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def entry_deployment(self) -> DeploymentRef:
+        """First listed deployment is the entry point (the service
+        surface), matching the reference's convention."""
+        return self.deployments[0]
+
+
+def validate_manifest(data: dict[str, Any]) -> AppManifest:
+    missing = [f for f in REQUIRED_FIELDS if not data.get(f)]
+    if missing:
+        raise ManifestError(f"manifest missing required fields: {missing}")
+    if data["type"] not in ACCEPTED_TYPES:
+        raise ManifestError(
+            f"manifest type must be one of {ACCEPTED_TYPES}, "
+            f"got '{data['type']}'"
+        )
+    deployments = []
+    for entry in data["deployments"]:
+        m = _DEPLOYMENT_RE.match(str(entry))
+        if not m:
+            raise ManifestError(
+                f"deployment entry '{entry}' is not 'file_stem:ClassName'"
+            )
+        deployments.append(DeploymentRef(m.group(1), m.group(2)))
+    if not deployments:
+        raise ManifestError("manifest needs at least one deployment")
+    return AppManifest(
+        name=str(data["name"]),
+        id=str(data["id"]),
+        id_emoji=str(data["id_emoji"]),
+        description=str(data["description"]),
+        type=data["type"],
+        deployments=deployments,
+        version=str(data.get("version", "1.0.0")),
+        frontend_entry=data.get("frontend_entry"),
+        authorized_users=list(data.get("authorized_users", []) or []),
+        deployment_config={
+            k: dict(v) for k, v in (data.get("deployment_config") or {}).items()
+        },
+        raw=dict(data),
+    )
+
+
+def load_manifest(path: str | Path) -> AppManifest:
+    path = Path(path)
+    if path.is_dir():
+        path = path / "manifest.yaml"
+    if not path.exists():
+        raise ManifestError(f"no manifest at {path}")
+    return validate_manifest(yaml.safe_load(path.read_text()) or {})

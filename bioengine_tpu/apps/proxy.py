@@ -1,0 +1,98 @@
+"""App service proxy — publish an app's entry methods on the RPC plane
+with per-method access control.
+
+The reference's ProxyDeployment registers one schema_function per entry
+``@schema_method`` on Hypha, enforces per-method ACLs (method-specific >
+wildcard > deny, ref bioengine/apps/proxy_deployment.py:345-403), counts
+in-flight requests, and deregisters the service the moment the entry
+goes unhealthy (:997-1088). Same responsibilities here, minus the
+mimic-request autoscaling hack — the controller measures load natively.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+from bioengine_tpu.apps.builder import BuiltApp
+from bioengine_tpu.rpc.server import RpcServer
+from bioengine_tpu.serving.controller import DeploymentHandle, ServeController
+from bioengine_tpu.utils.logger import create_logger
+from bioengine_tpu.utils.permissions import check_permissions
+
+# authorized_users may be a flat list (all methods) or a per-method map
+AclSpec = Union[list, dict]
+
+
+def check_method_permission(
+    acl: AclSpec, method: str, context: Optional[dict]
+) -> None:
+    """method-specific entry > wildcard entry > deny."""
+    if isinstance(acl, dict):
+        users = acl.get(method, acl.get("*"))
+    else:
+        users = acl
+    check_permissions(context, users, resource_name=f"method '{method}'")
+
+
+class AppServiceProxy:
+    def __init__(
+        self,
+        server: RpcServer,
+        controller: ServeController,
+        built: BuiltApp,
+        log_file: Optional[str] = None,
+    ):
+        self.server = server
+        self.controller = controller
+        self.built = built
+        self.service_id: Optional[str] = None
+        self.logger = create_logger(f"proxy.{built.app_id}", log_file=log_file)
+
+    @property
+    def handle(self) -> DeploymentHandle:
+        return self.controller.get_handle(
+            self.built.app_id, self.built.entry_name
+        )
+
+    def register(self) -> str:
+        """Register one proxy function per entry schema method."""
+        built = self.built
+        definition: dict[str, Any] = {
+            "id": built.app_id,
+            "name": built.manifest.name,
+            "type": "bioengine-app",
+            "description": built.manifest.description,
+            "config": {"require_context": True, "visibility": "public"},
+        }
+        for method_name, schema in built.schema_methods.items():
+            definition[method_name] = self._make_proxy_fn(method_name, schema)
+        definition["get_load"] = (
+            lambda context=None: self.controller.get_load(built.app_id)
+        )
+        entry = self.server.register_local_service(definition)
+        self.service_id = entry.full_id
+        self.logger.info(f"registered service {self.service_id}")
+        return self.service_id
+
+    def _make_proxy_fn(self, method_name: str, schema: dict):
+        acl = self.built.authorized_users
+
+        async def proxy_fn(*args, context=None, **kwargs):
+            check_method_permission(acl, method_name, context)
+            return await self.handle.call(method_name, *args, **kwargs)
+
+        proxy_fn.__name__ = method_name
+        proxy_fn.__doc__ = schema.get("description", "")
+        proxy_fn.__schema__ = schema
+        proxy_fn.__is_schema_method__ = True
+        return proxy_fn
+
+    def deregister(self) -> None:
+        if self.service_id:
+            self.server.unregister_service(self.service_id)
+            self.logger.info(f"deregistered service {self.service_id}")
+            self.service_id = None
+
+    @property
+    def registered(self) -> bool:
+        return self.service_id is not None

@@ -1,0 +1,5 @@
+from bioengine_tpu.cluster.cluster import TpuCluster
+from bioengine_tpu.cluster.state import ClusterState
+from bioengine_tpu.cluster.topology import TpuTopology, detect_topology
+
+__all__ = ["TpuCluster", "ClusterState", "TpuTopology", "detect_topology"]

@@ -1,0 +1,216 @@
+"""Cluster-state service: resource snapshots, history, replica registry,
+dead-replica logs, pending-workload queue.
+
+Replaces the reference's detached head-node proxy actor
+(ref bioengine/cluster/proxy_actor.py — per-node resources :332-350,
+pending workloads :105-165, serve-replica registry :473-561, dead-replica
+log retrieval :563-738) with a plain in-process service exposed over the
+framework's RPC plane. The 100-entry status-history ring mirrors
+ref bioengine/cluster/ray_cluster.py:844-861,171.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import psutil
+
+from bioengine_tpu.cluster.topology import TpuTopology, detect_topology
+from bioengine_tpu.utils.logger import timestamp
+
+HISTORY_MAX = 100
+
+
+@dataclass
+class ReplicaRecord:
+    app_id: str
+    deployment: str
+    replica_id: str
+    registered_at: float
+    device_ids: list[int] = field(default_factory=list)
+    alive: bool = True
+    log_tail: deque = field(default_factory=lambda: deque(maxlen=500))
+
+
+@dataclass
+class PendingWorkload:
+    workload_id: str
+    resources: dict[str, float]            # {"chips": 1, "cpus": 2, "memory_gb": 8}
+    submitted_at: float
+
+
+class ClusterState:
+    """In-memory cluster state; the worker registers its methods as an
+    RPC service so dashboards/CLIs read the same shape remotely."""
+
+    def __init__(self, topology: Optional[TpuTopology] = None):
+        self._topology = topology
+        self._history: deque[dict] = deque(maxlen=HISTORY_MAX)
+        self._replicas: dict[str, ReplicaRecord] = {}
+        self._pending: dict[str, PendingWorkload] = {}
+        self._chips_in_use: dict[int, str] = {}  # device_id -> replica_id
+        self.started_at = time.time()
+
+    # ---- topology / resources ----------------------------------------------
+
+    @property
+    def topology(self) -> TpuTopology:
+        if self._topology is None:
+            self._topology = detect_topology()
+        return self._topology
+
+    def snapshot(self) -> dict[str, Any]:
+        """One resource snapshot; appended to the history ring."""
+        vm = psutil.virtual_memory()
+        topo = self.topology
+        chips = []
+        for c in topo.chips:
+            chips.append(
+                {
+                    "device_id": c.device_id,
+                    "kind": c.kind,
+                    "hbm_bytes": c.hbm_bytes,
+                    "in_use_by": self._chips_in_use.get(c.device_id),
+                }
+            )
+        snap = {
+            "timestamp": time.time(),
+            "iso_time": timestamp(),
+            "cpu_percent": psutil.cpu_percent(interval=None),
+            "memory": {
+                "total_bytes": vm.total,
+                "available_bytes": vm.available,
+            },
+            "chips": chips,
+            "n_chips_free": sum(
+                1 for c in topo.chips if c.device_id not in self._chips_in_use
+            ),
+            "n_replicas": sum(1 for r in self._replicas.values() if r.alive),
+            "n_pending": len(self._pending),
+        }
+        self._history.append(snap)
+        return snap
+
+    def get_cluster_state(self) -> dict[str, Any]:
+        """The aggregate view the worker's get_status embeds."""
+        snap = self._history[-1] if self._history else self.snapshot()
+        return {
+            "topology": self.topology.as_dict(),
+            "current": snap,
+            "pending_workloads": [
+                {
+                    "workload_id": p.workload_id,
+                    "resources": p.resources,
+                    "age_seconds": time.time() - p.submitted_at,
+                }
+                for p in self._pending.values()
+            ],
+            "uptime_seconds": time.time() - self.started_at,
+        }
+
+    def history(self, n: int = HISTORY_MAX) -> list[dict]:
+        return list(self._history)[-n:]
+
+    # ---- chip accounting ----------------------------------------------------
+
+    def acquire_chips(self, replica_id: str, n: int) -> list[int]:
+        free = [
+            c.device_id
+            for c in self.topology.chips
+            if c.device_id not in self._chips_in_use
+        ]
+        if len(free) < n:
+            raise RuntimeError(
+                f"need {n} chips, only {len(free)} free "
+                f"({len(self._chips_in_use)} in use)"
+            )
+        taken = free[:n]
+        for d in taken:
+            self._chips_in_use[d] = replica_id
+        return taken
+
+    def release_chips(self, replica_id: str) -> None:
+        for d in [
+            d for d, r in self._chips_in_use.items() if r == replica_id
+        ]:
+            del self._chips_in_use[d]
+
+    def free_chips(self) -> int:
+        return self.topology.n_chips - len(self._chips_in_use)
+
+    # ---- pending workloads (drive the autoscaler) ---------------------------
+
+    def add_pending(self, workload_id: str, resources: dict[str, float]) -> None:
+        self._pending[workload_id] = PendingWorkload(
+            workload_id, resources, time.time()
+        )
+
+    def remove_pending(self, workload_id: str) -> None:
+        self._pending.pop(workload_id, None)
+
+    def pending(self) -> list[PendingWorkload]:
+        return list(self._pending.values())
+
+    # ---- replica registry + logs -------------------------------------------
+
+    def register_replica(
+        self,
+        app_id: str,
+        deployment: str,
+        replica_id: str,
+        device_ids: Optional[list[int]] = None,
+    ) -> None:
+        self._replicas[replica_id] = ReplicaRecord(
+            app_id=app_id,
+            deployment=deployment,
+            replica_id=replica_id,
+            registered_at=time.time(),
+            device_ids=device_ids or [],
+        )
+
+    def mark_replica_dead(self, replica_id: str) -> None:
+        rec = self._replicas.get(replica_id)
+        if rec:
+            rec.alive = False
+        self.release_chips(replica_id)
+
+    def append_replica_log(self, replica_id: str, line: str) -> None:
+        rec = self._replicas.get(replica_id)
+        if rec:
+            rec.log_tail.append(line)
+
+    def get_replica_logs(
+        self, app_id: str, include_dead: bool = True, max_lines: int = 200
+    ) -> dict[str, list[str]]:
+        """Per-replica log tails, INCLUDING dead replicas — parity with
+        the reference's dead-replica log retrieval
+        (ref bioengine/cluster/proxy_actor.py:563-738)."""
+        out = {}
+        for rec in self._replicas.values():
+            if rec.app_id != app_id:
+                continue
+            if not rec.alive and not include_dead:
+                continue
+            label = f"{rec.deployment}/{rec.replica_id}" + (
+                "" if rec.alive else " (dead)"
+            )
+            out[label] = list(rec.log_tail)[-max_lines:]
+        return out
+
+    def replicas(self, app_id: Optional[str] = None) -> list[ReplicaRecord]:
+        return [
+            r
+            for r in self._replicas.values()
+            if app_id is None or r.app_id == app_id
+        ]
+
+    # ---- RPC surface --------------------------------------------------------
+
+    def service_methods(self) -> dict[str, Any]:
+        return {
+            "get_cluster_state": lambda context=None: self.get_cluster_state(),
+            "get_history": lambda n=HISTORY_MAX, context=None: self.history(n),
+        }

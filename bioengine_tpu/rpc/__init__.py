@@ -1,0 +1,5 @@
+from bioengine_tpu.rpc.schema import schema_method
+from bioengine_tpu.rpc.client import connect_to_server
+from bioengine_tpu.rpc.server import RpcServer
+
+__all__ = ["schema_method", "connect_to_server", "RpcServer"]

@@ -1,0 +1,234 @@
+"""RPC client: connect to a control-plane server, register services,
+call remote services.
+
+API shape mirrors what the reference gets from hypha-rpc's
+``connect_to_server`` (a server object with register_service /
+get_service / generate_token, ref bioengine/worker/worker.py:522-612),
+so worker/app code reads the same against our in-repo control plane.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import Any, Callable, Optional
+
+import aiohttp
+
+from bioengine_tpu.rpc import protocol
+from bioengine_tpu.rpc.schema import extract_schema
+from bioengine_tpu.utils.logger import create_logger
+
+
+class ServiceProxy:
+    """Callable facade over a remote service: ``await svc.method(...)``."""
+
+    def __init__(self, connection: "ServerConnection", service_info: dict):
+        self._connection = connection
+        self._info = service_info
+        self.id = service_info["id"]
+
+    def __getattr__(self, name: str) -> Callable:
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        async def call(*args, **kwargs):
+            return await self._connection.call(self.id, name, *args, **kwargs)
+
+        call.__name__ = name
+        return call
+
+    def __repr__(self) -> str:
+        return f"<ServiceProxy {self.id} methods={self._info.get('methods')}>"
+
+
+class ServerConnection:
+    """A live WebSocket session with the RPC server."""
+
+    def __init__(self, url: str, token: Optional[str] = None, timeout: float = 300.0):
+        self.url = url
+        self.token = token
+        self.timeout = timeout
+        self.client_id: Optional[str] = None
+        self.workspace: Optional[str] = None
+        self.user_id: Optional[str] = None
+        self.logger = create_logger("rpc.client", log_file="off")
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._ws: Optional[aiohttp.ClientWebSocketResponse] = None
+        self._pending: dict[str, asyncio.Future] = {}
+        self._local_services: dict[str, dict[str, Callable]] = {}
+        self._reader_task: Optional[asyncio.Task] = None
+
+    async def connect(self) -> "ServerConnection":
+        self._session = aiohttp.ClientSession()
+        url = self.url
+        if self.token:
+            sep = "&" if "?" in url else "?"
+            url = f"{url}{sep}token={self.token}"
+        self._ws = await self._session.ws_connect(
+            url, max_msg_size=256 * 1024 * 1024
+        )
+        welcome = protocol.decode((await self._ws.receive()).data)
+        self.client_id = welcome["client_id"]
+        self.workspace = welcome["workspace"]
+        self.user_id = welcome["user_id"]
+        self._reader_task = asyncio.create_task(self._read_loop())
+        return self
+
+    async def disconnect(self) -> None:
+        if self._reader_task:
+            self._reader_task.cancel()
+        if self._ws:
+            await self._ws.close()
+        if self._session:
+            await self._session.close()
+
+    @property
+    def connected(self) -> bool:
+        return self._ws is not None and not self._ws.closed
+
+    # ---- request/response ---------------------------------------------------
+
+    async def _read_loop(self) -> None:
+        assert self._ws is not None
+        try:
+            async for msg in self._ws:
+                if msg.type != aiohttp.WSMsgType.BINARY:
+                    continue
+                data = protocol.decode(msg.data)
+                t = data.get("t")
+                if t in (protocol.RESULT, protocol.ERROR):
+                    fut = self._pending.pop(data.get("call_id", ""), None)
+                    if fut and not fut.done():
+                        if t == protocol.RESULT:
+                            fut.set_result(data.get("result"))
+                        else:
+                            err = data.get("error")
+                            if not isinstance(err, Exception):
+                                err = RuntimeError(str(err))
+                            fut.set_exception(err)
+                elif t == protocol.CALL:
+                    asyncio.create_task(self._handle_incoming_call(data))
+                elif t == protocol.PONG:
+                    fut = self._pending.pop("__ping__", None)
+                    if fut and not fut.done():
+                        fut.set_result(data.get("ts"))
+        except asyncio.CancelledError:
+            pass
+
+    async def _request(self, msg: dict) -> Any:
+        assert self._ws is not None, "not connected"
+        call_id = uuid.uuid4().hex
+        msg["call_id"] = call_id
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[call_id] = fut
+        await self._ws.send_bytes(protocol.encode(msg))
+        return await asyncio.wait_for(fut, self.timeout)
+
+    async def _handle_incoming_call(self, msg: dict) -> None:
+        """The server is routing another client's call to one of OUR
+        registered services."""
+        assert self._ws is not None
+        try:
+            service = self._local_services[msg["service_id"]]
+            fn = service[msg["method"]]
+            result = fn(*msg.get("args", []), **msg.get("kwargs", {}))
+            if asyncio.iscoroutine(result):
+                result = await result
+            await self._ws.send_bytes(
+                protocol.encode(
+                    {
+                        "t": protocol.RESULT,
+                        "call_id": msg.get("call_id"),
+                        "result": result,
+                    }
+                )
+            )
+        except Exception as e:
+            await self._ws.send_bytes(
+                protocol.encode(
+                    {
+                        "t": protocol.ERROR,
+                        "call_id": msg.get("call_id"),
+                        "error": e,
+                    }
+                )
+            )
+
+    # ---- public API (hypha-shaped) ------------------------------------------
+
+    async def register_service(self, definition: dict[str, Any]) -> dict:
+        methods = {k: v for k, v in definition.items() if callable(v)}
+        schemas = {
+            k: getattr(v, "__schema__", extract_schema(v))
+            for k, v in methods.items()
+        }
+        wire_def = {k: v for k, v in definition.items() if not callable(v)}
+        wire_def["methods"] = schemas
+        result = await self._request(
+            {"t": protocol.REGISTER, "definition": wire_def}
+        )
+        full_id = result["id"]
+        self._local_services[full_id] = methods
+        return {"id": full_id}
+
+    async def unregister_service(self, service_id: str) -> None:
+        await self._request(
+            {"t": protocol.UNREGISTER, "service_id": service_id}
+        )
+        self._local_services.pop(service_id, None)
+
+    async def list_services(self, workspace: Optional[str] = None) -> list[dict]:
+        return await self._request(
+            {"t": protocol.LIST, "workspace": workspace}
+        )
+
+    async def get_service(self, service_id: str) -> ServiceProxy:
+        services = await self.list_services()
+        for info in services:
+            if info["id"] == service_id or info["id"].endswith(f"/{service_id}"):
+                return ServiceProxy(self, info)
+        raise KeyError(f"Service '{service_id}' not found")
+
+    async def call(self, service_id: str, method: str, *args, **kwargs) -> Any:
+        return await self._request(
+            {
+                "t": protocol.CALL,
+                "service_id": service_id,
+                "method": method,
+                "args": list(args),
+                "kwargs": kwargs,
+            }
+        )
+
+    async def generate_token(self, config: Optional[dict] = None) -> str:
+        config = config or {}
+        return await self._request(
+            {
+                "t": protocol.TOKEN,
+                "user_id": config.get("user_id"),
+                "workspace": config.get("workspace"),
+                "ttl_seconds": config.get("expires_in"),
+                "is_admin": config.get("is_admin", False),
+            }
+        )
+
+    async def ping(self) -> float:
+        assert self._ws is not None
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending["__ping__"] = fut
+        await self._ws.send_bytes(protocol.encode({"t": protocol.PING}))
+        return await asyncio.wait_for(fut, 10.0)
+
+
+async def connect_to_server(config: dict[str, Any]) -> ServerConnection:
+    """hypha-style entry point: ``{"server_url": ..., "token": ...}``."""
+    url = config["server_url"]
+    if url.startswith("http"):
+        url = "ws" + url[4:]
+    if not url.endswith("/ws"):
+        url = url.rstrip("/") + "/ws"
+    conn = ServerConnection(
+        url, token=config.get("token"), timeout=config.get("method_timeout", 300.0)
+    )
+    return await conn.connect()

@@ -1,0 +1,92 @@
+"""Wire protocol for the BioEngine-TPU control plane.
+
+The reference speaks hypha-rpc (an external WebSocket RPC service,
+ref bioengine/worker/worker.py:522-612 connects out to it). This
+framework ships its own control plane with the same *shape* —
+service registration, method calls with injected caller context,
+token auth — so deployments need no external RPC broker.
+
+Messages are msgpack maps with a ``t`` (type) field. Payloads pass
+through ``encode``/``decode`` which handle numpy arrays (zero-copy
+raw-bytes + dtype/shape envelope), bytes, and Exception values.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Any
+
+import msgpack
+import numpy as np
+
+# message types
+REGISTER = "register"          # client -> server: register a service
+UNREGISTER = "unregister"
+CALL = "call"                  # caller -> server -> provider
+RESULT = "result"              # provider -> server -> caller
+ERROR = "error"
+TOKEN = "token"                # generate_token request
+LIST = "list_services"
+PING = "ping"
+PONG = "pong"
+
+
+def _default(obj: Any) -> Any:
+    if isinstance(obj, np.ndarray):
+        return msgpack.ExtType(
+            1,
+            msgpack.packb(
+                {
+                    "dtype": obj.dtype.str,
+                    "shape": list(obj.shape),
+                    "data": obj.tobytes(),
+                }
+            ),
+        )
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, Exception):
+        return msgpack.ExtType(
+            2,
+            msgpack.packb(
+                {
+                    "type": type(obj).__name__,
+                    "message": str(obj),
+                    "traceback": "".join(
+                        traceback.format_exception(obj)
+                    )[-4000:],
+                }
+            ),
+        )
+    raise TypeError(f"Cannot serialize {type(obj)}")
+
+
+class RemoteError(RuntimeError):
+    """An exception raised on the provider side of an RPC call."""
+
+    def __init__(self, type_name: str, message: str, remote_traceback: str = ""):
+        super().__init__(f"{type_name}: {message}")
+        self.type_name = type_name
+        self.remote_traceback = remote_traceback
+
+
+def _ext_hook(code: int, data: bytes) -> Any:
+    if code == 1:
+        d = msgpack.unpackb(data)
+        return np.frombuffer(d["data"], dtype=np.dtype(d["dtype"])).reshape(
+            d["shape"]
+        )
+    if code == 2:
+        d = msgpack.unpackb(data)
+        return RemoteError(d["type"], d["message"], d.get("traceback", ""))
+    return msgpack.ExtType(code, data)
+
+
+def encode(msg: dict) -> bytes:
+    return msgpack.packb(msg, default=_default, use_bin_type=True)
+
+
+def decode(data: bytes) -> dict:
+    return msgpack.unpackb(data, ext_hook=_ext_hook, raw=False)

@@ -1,0 +1,436 @@
+"""The control-plane RPC server.
+
+Replaces the external Hypha server the reference depends on (the worker
+connects OUT to hypha.aicell.io and registers its service dict, ref
+bioengine/worker/worker.py:522-664). Here the control plane is part of
+the framework: an aiohttp WebSocket server hosting a service registry
+with token auth and caller-context injection. A worker can either run
+this server itself (standalone mode) or connect to a remote instance —
+the same two topologies the reference supports with Hypha.
+
+Capabilities:
+- token issue/validate (``generate_token`` with expiry; admin users)
+- service registration from any connected client or in-process object
+- method calls routed caller -> provider with ``context`` injection
+  (``config.require_context``, same convention as the reference's
+  services, ref bioengine/utils/permissions.py create_context)
+- service listing/metadata incl. method schemas
+"""
+
+from __future__ import annotations
+
+import asyncio
+import secrets
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from aiohttp import WSMsgType, web
+
+from bioengine_tpu.rpc import protocol
+from bioengine_tpu.rpc.schema import extract_schema
+from bioengine_tpu.utils.logger import create_logger
+
+
+@dataclass
+class TokenInfo:
+    user_id: str
+    workspace: str
+    expires_at: float
+    is_admin: bool = False
+
+
+@dataclass
+class ServiceEntry:
+    service_id: str
+    workspace: str
+    owner_client: Optional[str]      # ws connection id; None = in-process
+    definition: dict[str, Any]
+    methods: dict[str, Callable] = field(default_factory=dict)  # in-process only
+    schemas: dict[str, dict] = field(default_factory=dict)
+
+    @property
+    def full_id(self) -> str:
+        return f"{self.workspace}/{self.service_id}"
+
+
+class RpcServer:
+    """In-process + WebSocket service registry and call router."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        admin_users: Optional[list[str]] = None,
+        default_workspace: str = "bioengine",
+        token_ttl_seconds: float = 3600 * 24,
+    ):
+        self.host = host
+        self.port = port
+        self.default_workspace = default_workspace
+        self.admin_users = list(admin_users or [])
+        self.token_ttl_seconds = token_ttl_seconds
+        self.logger = create_logger("rpc.server", log_file="off")
+
+        self._tokens: dict[str, TokenInfo] = {}
+        self._services: dict[str, ServiceEntry] = {}
+        self._clients: dict[str, web.WebSocketResponse] = {}
+        self._client_users: dict[str, TokenInfo] = {}
+        self._pending: dict[str, asyncio.Future] = {}
+        self._runner: Optional[web.AppRunner] = None
+        self._site: Optional[web.TCPSite] = None
+
+    # ---- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> str:
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        app.router.add_get("/ws", self._handle_ws)
+        app.router.add_get("/health/liveness", self._handle_health)
+        app.router.add_get("/services", self._handle_list_http)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, self.host, self.port)
+        await self._site.start()
+        self.port = self._site._server.sockets[0].getsockname()[1]
+        self.logger.info(f"RPC server listening on ws://{self.host}:{self.port}/ws")
+        return self.url
+
+    async def stop(self) -> None:
+        for ws in list(self._clients.values()):
+            await ws.close()
+        if self._runner:
+            await self._runner.cleanup()
+
+    @property
+    def url(self) -> str:
+        return f"ws://{self.host}:{self.port}/ws"
+
+    # ---- tokens -------------------------------------------------------------
+
+    def issue_token(
+        self,
+        user_id: str,
+        workspace: Optional[str] = None,
+        ttl_seconds: Optional[float] = None,
+        is_admin: Optional[bool] = None,
+    ) -> str:
+        token = secrets.token_urlsafe(32)
+        self._tokens[token] = TokenInfo(
+            user_id=user_id,
+            workspace=workspace or self.default_workspace,
+            expires_at=time.time() + (ttl_seconds or self.token_ttl_seconds),
+            is_admin=user_id in self.admin_users if is_admin is None else is_admin,
+        )
+        return token
+
+    def validate_token(self, token: str) -> TokenInfo:
+        info = self._tokens.get(token)
+        if info is None:
+            raise PermissionError("Unknown token")
+        if time.time() > info.expires_at:
+            del self._tokens[token]
+            raise PermissionError("Token expired")
+        return info
+
+    def _context_for(self, info: TokenInfo) -> dict:
+        return {
+            "user": {
+                "id": info.user_id,
+                "email": f"{info.user_id}@bioengine",
+                "is_anonymous": info.user_id == "anonymous",
+                "roles": ["admin"] if info.is_admin else [],
+            },
+            "ws": info.workspace,
+        }
+
+    # ---- in-process services ------------------------------------------------
+
+    def register_local_service(self, definition: dict[str, Any]) -> ServiceEntry:
+        """Register a service whose methods are local callables (the path
+        the worker itself uses in standalone mode)."""
+        service_id = definition["id"]
+        workspace = definition.get("workspace", self.default_workspace)
+        methods = {
+            k: v for k, v in definition.items() if callable(v)
+        }
+        entry = ServiceEntry(
+            service_id=service_id,
+            workspace=workspace,
+            owner_client=None,
+            definition={
+                k: v for k, v in definition.items() if not callable(v)
+            },
+            methods=methods,
+            schemas={
+                k: getattr(v, "__schema__", None) or extract_schema(v)
+                for k, v in methods.items()
+            },
+        )
+        self._services[entry.full_id] = entry
+        self.logger.info(f"Registered local service {entry.full_id}")
+        return entry
+
+    def unregister_service(self, full_id: str) -> None:
+        self._services.pop(full_id, None)
+
+    def list_services(self, workspace: Optional[str] = None) -> list[dict]:
+        out = []
+        for entry in self._services.values():
+            if workspace and entry.workspace != workspace:
+                continue
+            out.append(
+                {
+                    "id": entry.full_id,
+                    "name": entry.definition.get("name", entry.service_id),
+                    "type": entry.definition.get("type", "generic"),
+                    "description": entry.definition.get("description", ""),
+                    "config": entry.definition.get("config", {}),
+                    "methods": sorted(entry.schemas),
+                }
+            )
+        return out
+
+    async def call_service_method(
+        self,
+        full_id: str,
+        method: str,
+        args: tuple = (),
+        kwargs: Optional[dict] = None,
+        caller: Optional[TokenInfo] = None,
+        timeout: float = 300.0,
+    ) -> Any:
+        """Route a call to an in-process or remote-client service."""
+        kwargs = dict(kwargs or {})
+        entry = self._find_service(full_id)
+        require_context = entry.definition.get("config", {}).get(
+            "require_context", False
+        )
+        if require_context:
+            kwargs["context"] = self._context_for(
+                caller
+                or TokenInfo("anonymous", self.default_workspace, time.time() + 60)
+            )
+        if entry.owner_client is None:
+            fn = entry.methods.get(method)
+            if fn is None:
+                raise AttributeError(f"{full_id} has no method '{method}'")
+            result = fn(*args, **kwargs)
+            if asyncio.iscoroutine(result):
+                result = await result
+            return result
+        # remote provider: forward over its websocket
+        ws = self._clients.get(entry.owner_client)
+        if ws is None or ws.closed:
+            raise ConnectionError(f"Provider for {full_id} is gone")
+        call_id = uuid.uuid4().hex
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[call_id] = fut
+        try:
+            await ws.send_bytes(
+                protocol.encode(
+                    {
+                        "t": protocol.CALL,
+                        "call_id": call_id,
+                        "service_id": full_id,
+                        "method": method,
+                        "args": list(args),
+                        "kwargs": kwargs,
+                    }
+                )
+            )
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(call_id, None)
+
+    def _find_service(self, full_id: str) -> ServiceEntry:
+        if full_id in self._services:
+            return self._services[full_id]
+        # allow bare ids (unique across workspaces) like the reference's
+        # service lookup convenience
+        matches = [
+            e for e in self._services.values() if e.service_id == full_id
+        ]
+        if len(matches) == 1:
+            return matches[0]
+        raise KeyError(f"Service '{full_id}' not found")
+
+    # ---- websocket handling -------------------------------------------------
+
+    async def _handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "services": len(self._services)})
+
+    async def _handle_list_http(self, request: web.Request) -> web.Response:
+        return web.json_response(self.list_services())
+
+    async def _handle_ws(self, request: web.Request) -> web.WebSocketResponse:
+        token = request.query.get("token", "")
+        try:
+            if token:
+                info = self.validate_token(token)
+            else:
+                info = TokenInfo(
+                    "anonymous", self.default_workspace, time.time() + 86400
+                )
+        except PermissionError as e:
+            raise web.HTTPUnauthorized(reason=str(e))
+
+        ws = web.WebSocketResponse(max_msg_size=256 * 1024 * 1024)
+        await ws.prepare(request)
+        client_id = uuid.uuid4().hex
+        self._clients[client_id] = ws
+        self._client_users[client_id] = info
+        await ws.send_bytes(
+            protocol.encode(
+                {
+                    "t": "welcome",
+                    "client_id": client_id,
+                    "workspace": info.workspace,
+                    "user_id": info.user_id,
+                }
+            )
+        )
+        try:
+            async for msg in ws:
+                if msg.type != WSMsgType.BINARY:
+                    continue
+                try:
+                    await self._dispatch(client_id, ws, protocol.decode(msg.data))
+                except Exception as e:  # keep the connection alive
+                    self.logger.error(f"dispatch error: {e}")
+        finally:
+            self._drop_client(client_id)
+        return ws
+
+    def _drop_client(self, client_id: str) -> None:
+        self._clients.pop(client_id, None)
+        self._client_users.pop(client_id, None)
+        for full_id in [
+            fid
+            for fid, e in self._services.items()
+            if e.owner_client == client_id
+        ]:
+            del self._services[full_id]
+            self.logger.info(f"Dropped service {full_id} (client disconnect)")
+
+    async def _dispatch(
+        self, client_id: str, ws: web.WebSocketResponse, msg: dict
+    ) -> None:
+        t = msg.get("t")
+        info = self._client_users[client_id]
+        if t == protocol.PING:
+            await ws.send_bytes(
+                protocol.encode({"t": protocol.PONG, "ts": time.time()})
+            )
+        elif t == protocol.REGISTER:
+            definition = msg["definition"]
+            entry = ServiceEntry(
+                service_id=definition["id"],
+                workspace=info.workspace,
+                owner_client=client_id,
+                definition={
+                    k: v for k, v in definition.items() if k != "methods"
+                },
+                schemas=definition.get("methods", {}),
+            )
+            self._services[entry.full_id] = entry
+            await ws.send_bytes(
+                protocol.encode(
+                    {
+                        "t": protocol.RESULT,
+                        "call_id": msg.get("call_id"),
+                        "result": {"id": entry.full_id},
+                    }
+                )
+            )
+        elif t == protocol.UNREGISTER:
+            entry = self._services.get(msg["service_id"])
+            if entry and entry.owner_client == client_id:
+                del self._services[msg["service_id"]]
+            await ws.send_bytes(
+                protocol.encode(
+                    {
+                        "t": protocol.RESULT,
+                        "call_id": msg.get("call_id"),
+                        "result": True,
+                    }
+                )
+            )
+        elif t == protocol.TOKEN:
+            if not info.is_admin:
+                await self._send_error(
+                    ws, msg.get("call_id"), PermissionError("admin required")
+                )
+                return
+            # clients send explicit None for unset fields — `or` fallback,
+            # not a .get default, so None resolves to the caller's identity
+            token = self.issue_token(
+                user_id=msg.get("user_id") or info.user_id,
+                workspace=msg.get("workspace") or info.workspace,
+                ttl_seconds=msg.get("ttl_seconds"),
+                is_admin=bool(msg.get("is_admin")),
+            )
+            await ws.send_bytes(
+                protocol.encode(
+                    {
+                        "t": protocol.RESULT,
+                        "call_id": msg.get("call_id"),
+                        "result": token,
+                    }
+                )
+            )
+        elif t == protocol.LIST:
+            await ws.send_bytes(
+                protocol.encode(
+                    {
+                        "t": protocol.RESULT,
+                        "call_id": msg.get("call_id"),
+                        "result": self.list_services(msg.get("workspace")),
+                    }
+                )
+            )
+        elif t == protocol.CALL:
+            asyncio.create_task(self._handle_call(ws, info, msg))
+        elif t == protocol.RESULT:
+            fut = self._pending.get(msg.get("call_id", ""))
+            if fut and not fut.done():
+                fut.set_result(msg.get("result"))
+        elif t == protocol.ERROR:
+            fut = self._pending.get(msg.get("call_id", ""))
+            if fut and not fut.done():
+                err = msg.get("error")
+                if not isinstance(err, Exception):
+                    err = RuntimeError(str(err))
+                fut.set_exception(err)
+
+    async def _handle_call(
+        self, ws: web.WebSocketResponse, info: TokenInfo, msg: dict
+    ) -> None:
+        try:
+            result = await self.call_service_method(
+                msg["service_id"],
+                msg["method"],
+                tuple(msg.get("args", ())),
+                msg.get("kwargs", {}),
+                caller=info,
+            )
+            await ws.send_bytes(
+                protocol.encode(
+                    {
+                        "t": protocol.RESULT,
+                        "call_id": msg.get("call_id"),
+                        "result": result,
+                    }
+                )
+            )
+        except Exception as e:
+            await self._send_error(ws, msg.get("call_id"), e)
+
+    async def _send_error(
+        self, ws: web.WebSocketResponse, call_id: Optional[str], error: Exception
+    ) -> None:
+        await ws.send_bytes(
+            protocol.encode(
+                {"t": protocol.ERROR, "call_id": call_id, "error": error}
+            )
+        )

@@ -1,0 +1,16 @@
+from bioengine_tpu.serving.batching import ContinuousBatcher
+from bioengine_tpu.serving.controller import (
+    DeploymentHandle,
+    DeploymentSpec,
+    ServeController,
+)
+from bioengine_tpu.serving.replica import Replica, ReplicaState
+
+__all__ = [
+    "ContinuousBatcher",
+    "DeploymentHandle",
+    "DeploymentSpec",
+    "ServeController",
+    "Replica",
+    "ReplicaState",
+]

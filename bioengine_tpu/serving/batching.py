@@ -1,0 +1,123 @@
+"""Continuous batching for inference replicas.
+
+Named in the north star (BASELINE.json: "route inference requests to TPU
+replicas with continuous batching") and absent from the reference, which
+forwards each request individually to the torch pipeline
+(ref apps/model-runner/runtime_deployment.py:234-312).
+
+Requests accumulate in an async queue; a drainer groups them by a
+caller-provided signature (e.g. model id + shape bucket) and invokes the
+batch function once per group. Groups close when ``max_batch`` is
+reached or ``max_wait_ms`` elapses since the group's first request —
+latency is bounded while the TPU sees large batches. Pairs with the
+shape-bucketed InferenceEngine: batching by bucket signature means one
+compiled program per flush.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Hashable, Optional
+
+
+@dataclass
+class _PendingRequest:
+    payload: Any
+    future: asyncio.Future
+    enqueued_at: float = field(default_factory=time.monotonic)
+
+
+BatchFn = Callable[[Hashable, list[Any]], Awaitable[list[Any]]]
+
+
+class ContinuousBatcher:
+    """``submit(signature, payload)`` -> awaitable per-request result.
+
+    ``batch_fn(signature, payloads) -> results`` runs once per flushed
+    group; results map 1:1 onto payload order.
+    """
+
+    def __init__(
+        self,
+        batch_fn: BatchFn,
+        max_batch: int = 8,
+        max_wait_ms: float = 10.0,
+    ):
+        self.batch_fn = batch_fn
+        self.max_batch = max_batch
+        self.max_wait_ms = max_wait_ms
+        self._groups: dict[Hashable, list[_PendingRequest]] = {}
+        self._flush_tasks: dict[Hashable, asyncio.Task] = {}
+        self._stats = {"requests": 0, "batches": 0, "batched_requests": 0}
+        self._closed = False
+
+    async def submit(self, signature: Hashable, payload: Any) -> Any:
+        if self._closed:
+            raise RuntimeError("batcher is closed")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        group = self._groups.setdefault(signature, [])
+        group.append(_PendingRequest(payload, fut))
+        self._stats["requests"] += 1
+        if len(group) >= self.max_batch:
+            self._cancel_timer(signature)
+            await self._flush(signature)
+        elif signature not in self._flush_tasks:
+            self._flush_tasks[signature] = asyncio.create_task(
+                self._timed_flush(signature)
+            )
+        return await fut
+
+    async def _timed_flush(self, signature: Hashable) -> None:
+        try:
+            await asyncio.sleep(self.max_wait_ms / 1000.0)
+            # Deregister BEFORE the (awaitable) flush: a request arriving
+            # for this signature while batch_fn runs must see no timer
+            # and schedule its own, or it would wait forever.
+            self._flush_tasks.pop(signature, None)
+            await self._flush(signature)
+        except asyncio.CancelledError:
+            self._flush_tasks.pop(signature, None)
+
+    def _cancel_timer(self, signature: Hashable) -> None:
+        task = self._flush_tasks.pop(signature, None)
+        if task:
+            task.cancel()
+
+    async def _flush(self, signature: Hashable) -> None:
+        group = self._groups.pop(signature, [])
+        if not group:
+            return
+        self._stats["batches"] += 1
+        self._stats["batched_requests"] += len(group)
+        try:
+            results = await self.batch_fn(
+                signature, [r.payload for r in group]
+            )
+            if len(results) != len(group):
+                raise RuntimeError(
+                    f"batch_fn returned {len(results)} results for "
+                    f"{len(group)} requests"
+                )
+            for req, res in zip(group, results):
+                if not req.future.done():
+                    req.future.set_result(res)
+        except Exception as e:
+            for req in group:
+                if not req.future.done():
+                    req.future.set_exception(e)
+
+    async def close(self) -> None:
+        self._closed = True
+        for signature in list(self._groups):
+            self._cancel_timer(signature)
+            await self._flush(signature)
+
+    @property
+    def stats(self) -> dict:
+        s = dict(self._stats)
+        s["avg_batch_size"] = (
+            s["batched_requests"] / s["batches"] if s["batches"] else 0.0
+        )
+        return s

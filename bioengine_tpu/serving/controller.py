@@ -1,0 +1,339 @@
+"""ServeController — deployment orchestration, health loop, autoscaling.
+
+Replaces Ray Serve as used by the reference (serve.run per app with
+autoscaling 1-10 replicas and health-check-driven restarts, ref
+bioengine/apps/proxy_deployment.py:25-47, bioengine/apps/manager.py:
+355-455). Differences by design:
+
+- Load is measured at the controller (per-replica semaphore occupancy +
+  queue depth), so the reference's "mimic request" workaround for the
+  Serve autoscaler (proxy_deployment.py:405-442) has no equivalent —
+  the signal is native.
+- Replicas scale in whole units, each owning a fixed chip set leased
+  from ClusterState; unplaceable replicas enqueue a pending workload,
+  which is exactly what drives the provisioner's scale-up
+  (cluster/provisioner.py check_scaling).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from bioengine_tpu.cluster.state import ClusterState
+from bioengine_tpu.serving.replica import Replica, ReplicaState
+from bioengine_tpu.utils.logger import create_logger
+
+
+@dataclass
+class DeploymentSpec:
+    name: str
+    instance_factory: Callable[[], Any]
+    num_replicas: int = 1
+    min_replicas: int = 1
+    max_replicas: int = 3
+    chips_per_replica: int = 0
+    max_ongoing_requests: int = 10
+    autoscale: bool = True
+    target_load: float = 0.7          # scale up above, down below half
+
+
+@dataclass
+class AppDeployment:
+    app_id: str
+    specs: dict[str, DeploymentSpec]
+    replicas: dict[str, list[Replica]] = field(default_factory=dict)
+    created_at: float = field(default_factory=time.time)
+    status: str = "DEPLOYING"         # DEPLOYING | RUNNING | UNHEALTHY | DEPLOY_FAILED | STOPPED
+
+
+class DeploymentHandle:
+    """Client-side handle: route calls to healthy replicas (least-loaded,
+    round-robin tie-break). The composition mechanism: entry deployments
+    receive handles to their sibling deployments as init kwargs, same as
+    the reference's DeploymentHandle binding (ref apps/builder.py:1474-1508)."""
+
+    def __init__(self, controller: "ServeController", app_id: str, deployment: str):
+        self._controller = controller
+        self.app_id = app_id
+        self.deployment = deployment
+        self._rr = itertools.count()
+
+    async def call(self, method: str, *args, **kwargs) -> Any:
+        replica = self._controller._pick_replica(self.app_id, self.deployment)
+        self._controller._queue_depth[(self.app_id, self.deployment)] += 1
+        try:
+            return await replica.call(method, *args, **kwargs)
+        finally:
+            self._controller._queue_depth[(self.app_id, self.deployment)] -= 1
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        async def invoke(*args, **kwargs):
+            return await self.call(name, *args, **kwargs)
+
+        invoke.__name__ = name
+        return invoke
+
+
+class ServeController:
+    def __init__(
+        self,
+        cluster_state: Optional[ClusterState] = None,
+        health_check_period: float = 10.0,
+        log_file: Optional[str] = None,
+    ):
+        self.cluster_state = cluster_state or ClusterState()
+        self.health_check_period = health_check_period
+        self.apps: dict[str, AppDeployment] = {}
+        self.logger = create_logger("serving", log_file=log_file)
+        self._health_task: Optional[asyncio.Task] = None
+        self._queue_depth: dict[tuple[str, str], int] = defaultdict(int)
+        self._rr_counters: dict[tuple[str, str], itertools.count] = {}
+
+    # ---- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        if self._health_task is None:
+            self._health_task = asyncio.create_task(self._health_loop())
+
+    async def stop(self) -> None:
+        if self._health_task:
+            self._health_task.cancel()
+            self._health_task = None
+        for app_id in list(self.apps):
+            await self.undeploy(app_id)
+
+    # ---- deploy / undeploy --------------------------------------------------
+
+    async def deploy(
+        self, app_id: str, specs: list[DeploymentSpec]
+    ) -> AppDeployment:
+        existing = self.apps.get(app_id)
+        if existing is not None:
+            if existing.status in ("DEPLOY_FAILED", "STOPPED"):
+                del self.apps[app_id]  # failed attempt may be retried
+            else:
+                raise ValueError(f"app '{app_id}' already deployed")
+        app = AppDeployment(app_id=app_id, specs={s.name: s for s in specs})
+        self.apps[app_id] = app
+        try:
+            for spec in specs:
+                app.replicas[spec.name] = []
+                for _ in range(spec.num_replicas):
+                    await self._add_replica(app, spec)
+            app.status = "RUNNING"
+            self.logger.info(f"app '{app_id}' deployed")
+        except Exception:
+            # Roll back partial state: stop started replicas and release
+            # their chip leases so a failed deploy leaks nothing.
+            app.status = "DEPLOY_FAILED"
+            for replicas in app.replicas.values():
+                for r in replicas:
+                    try:
+                        await r.stop()
+                    finally:
+                        self.cluster_state.mark_replica_dead(r.replica_id)
+            raise
+        return app
+
+    async def _add_replica(self, app: AppDeployment, spec: DeploymentSpec) -> Replica:
+        replica = Replica(
+            app_id=app.app_id,
+            deployment_name=spec.name,
+            instance_factory=spec.instance_factory,
+            max_ongoing_requests=spec.max_ongoing_requests,
+            log_sink=self.cluster_state.append_replica_log,
+        )
+        if spec.chips_per_replica > 0:
+            try:
+                replica.device_ids = self.cluster_state.acquire_chips(
+                    replica.replica_id, spec.chips_per_replica
+                )
+            except RuntimeError:
+                # No capacity: surface as pending workload so the
+                # provisioner can scale out (ref manager.py:239-353's
+                # SLURM headroom allowance).
+                self.cluster_state.add_pending(
+                    f"{app.app_id}/{spec.name}",
+                    {"chips": spec.chips_per_replica},
+                )
+                raise
+        self.cluster_state.register_replica(
+            app.app_id, spec.name, replica.replica_id, replica.device_ids
+        )
+        try:
+            await replica.start()
+        except Exception:
+            self.cluster_state.mark_replica_dead(replica.replica_id)
+            app.replicas[spec.name].append(replica)
+            raise
+        app.replicas[spec.name].append(replica)
+        self.cluster_state.remove_pending(f"{app.app_id}/{spec.name}")
+        return replica
+
+    async def undeploy(self, app_id: str) -> None:
+        app = self.apps.pop(app_id, None)
+        if app is None:
+            return
+        for replicas in app.replicas.values():
+            for r in replicas:
+                await r.stop()
+                self.cluster_state.mark_replica_dead(r.replica_id)
+        app.status = "STOPPED"
+        self.logger.info(f"app '{app_id}' undeployed")
+
+    # ---- request routing ----------------------------------------------------
+
+    def get_handle(self, app_id: str, deployment: Optional[str] = None) -> DeploymentHandle:
+        app = self.apps.get(app_id)
+        if app is None:
+            raise KeyError(f"app '{app_id}' not deployed")
+        if deployment is None:
+            deployment = next(iter(app.specs))
+        if deployment not in app.specs:
+            raise KeyError(f"app '{app_id}' has no deployment '{deployment}'")
+        self._queue_depth.setdefault((app_id, deployment), 0)
+        return DeploymentHandle(self, app_id, deployment)
+
+    def _pick_replica(self, app_id: str, deployment: str) -> Replica:
+        app = self.apps.get(app_id)
+        if app is None:
+            raise KeyError(f"app '{app_id}' not deployed")
+        healthy = [
+            r
+            for r in app.replicas.get(deployment, [])
+            if r.state == ReplicaState.HEALTHY
+        ]
+        if not healthy:
+            raise RuntimeError(
+                f"no healthy replicas for {app_id}/{deployment}"
+            )
+        min_load = min(r.load for r in healthy)
+        candidates = [r for r in healthy if r.load == min_load]
+        rr = self._rr_counters.setdefault(
+            (app_id, deployment), itertools.count()
+        )
+        return candidates[next(rr) % len(candidates)]
+
+    # ---- health + autoscaling loop ------------------------------------------
+
+    async def _health_loop(self) -> None:
+        while True:
+            try:
+                await asyncio.sleep(self.health_check_period)
+                await self.health_tick()
+            except asyncio.CancelledError:
+                return
+            except Exception as e:
+                self.logger.error(f"health loop error: {e}")
+
+    async def health_tick(self) -> None:
+        """One pass: health-check replicas, restart dead ones, autoscale."""
+        for app in list(self.apps.values()):
+            any_unhealthy = False
+            for spec_name, spec in app.specs.items():
+                replicas = app.replicas.get(spec_name, [])
+                for r in list(replicas):
+                    state = await r.check_health()
+                    if state == ReplicaState.UNHEALTHY:
+                        any_unhealthy = True
+                        self.logger.warning(
+                            f"restarting unhealthy replica {r.replica_id}"
+                        )
+                        await r.stop()
+                        self.cluster_state.mark_replica_dead(r.replica_id)
+                        replicas.remove(r)
+                        try:
+                            await self._add_replica(app, spec)
+                        except Exception as e:
+                            self.logger.error(
+                                f"replica restart failed for "
+                                f"{app.app_id}/{spec_name}: {e}"
+                            )
+                await self._autoscale(app, spec)
+                alive = [
+                    r
+                    for r in app.replicas.get(spec_name, [])
+                    if r.state in (ReplicaState.HEALTHY, ReplicaState.TESTING,
+                                   ReplicaState.INITIALIZING)
+                ]
+                if not alive:
+                    any_unhealthy = True
+            app.status = "UNHEALTHY" if any_unhealthy else "RUNNING"
+
+    async def _autoscale(self, app: AppDeployment, spec: DeploymentSpec) -> None:
+        if not spec.autoscale:
+            return
+        replicas = app.replicas.get(spec.name, [])
+        healthy = [r for r in replicas if r.state == ReplicaState.HEALTHY]
+        if not healthy:
+            return
+        avg_load = sum(r.load for r in healthy) / len(healthy)
+        depth = self._queue_depth.get((app.app_id, spec.name), 0)
+        if (
+            avg_load > spec.target_load or depth > len(healthy) * spec.max_ongoing_requests
+        ) and len(replicas) < spec.max_replicas:
+            self.logger.info(
+                f"autoscale UP {app.app_id}/{spec.name} "
+                f"(load={avg_load:.2f}, depth={depth})"
+            )
+            try:
+                await self._add_replica(app, spec)
+            except Exception as e:
+                self.logger.warning(f"autoscale up blocked: {e}")
+        elif (
+            avg_load < spec.target_load / 2
+            and depth == 0
+            and len(healthy) > spec.min_replicas
+        ):
+            victim = max(healthy, key=lambda r: r.load == 0.0)
+            if victim.load == 0.0:
+                self.logger.info(
+                    f"autoscale DOWN {app.app_id}/{spec.name} "
+                    f"({victim.replica_id})"
+                )
+                await victim.stop()
+                self.cluster_state.mark_replica_dead(victim.replica_id)
+                app.replicas[spec.name].remove(victim)
+
+    # ---- status -------------------------------------------------------------
+
+    def get_app_status(self, app_id: str) -> dict:
+        app = self.apps.get(app_id)
+        if app is None:
+            raise KeyError(f"app '{app_id}' not deployed")
+        return {
+            "app_id": app_id,
+            "status": app.status,
+            "created_at": app.created_at,
+            "deployments": {
+                name: {
+                    "num_replicas": len(replicas),
+                    "replicas": [r.describe() for r in replicas],
+                    "queue_depth": self._queue_depth.get((app_id, name), 0),
+                }
+                for name, replicas in app.replicas.items()
+            },
+        }
+
+    def list_apps(self) -> list[str]:
+        return sorted(self.apps)
+
+    def get_load(self, app_id: str) -> float:
+        app = self.apps.get(app_id)
+        if not app:
+            return 0.0
+        loads = [
+            r.load
+            for replicas in app.replicas.values()
+            for r in replicas
+            if r.state == ReplicaState.HEALTHY
+        ]
+        return sum(loads) / len(loads) if loads else 0.0

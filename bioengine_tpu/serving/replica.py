@@ -1,0 +1,189 @@
+"""Replica — one health-checked instance of an app deployment pinned to
+a device set.
+
+The reference's unit is a Ray Serve replica actor wrapped by AppBuilder:
+``__init__`` registers the replica, ``async_init`` does async setup,
+``test_deployment`` runs once in the background, ``check_health``
+orchestrates init -> test -> datasets ping -> user health check
+(ref bioengine/apps/builder.py:532-890). This class reproduces that
+lifecycle chain without Ray: the instance is a plain Python object
+constructed from the app build, pinned to chips accounted in
+ClusterState, driven by the controller's health loop.
+
+Scaling stays XLA-friendly: a replica owns a FIXED device set for its
+whole life, so its compiled programs never re-shard (SURVEY.md §7
+"Replica elasticity vs. XLA's static world" — scale in units of whole
+replicas).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import time
+import traceback
+import uuid
+from typing import Any, Callable, Optional
+
+from bioengine_tpu.utils.logger import create_logger
+
+
+class ReplicaState(str, enum.Enum):
+    STARTING = "STARTING"
+    INITIALIZING = "INITIALIZING"
+    TESTING = "TESTING"
+    HEALTHY = "HEALTHY"
+    UNHEALTHY = "UNHEALTHY"
+    STOPPED = "STOPPED"
+
+
+class Replica:
+    def __init__(
+        self,
+        app_id: str,
+        deployment_name: str,
+        instance_factory: Callable[[], Any],
+        device_ids: Optional[list[int]] = None,
+        max_ongoing_requests: int = 10,
+        log_sink: Optional[Callable[[str, str], None]] = None,
+    ):
+        self.app_id = app_id
+        self.deployment_name = deployment_name
+        self.replica_id = f"{deployment_name}-{uuid.uuid4().hex[:8]}"
+        self.device_ids = device_ids or []
+        self.state = ReplicaState.STARTING
+        self.max_ongoing_requests = max_ongoing_requests
+        self._instance_factory = instance_factory
+        self.instance: Any = None
+        self._semaphore = asyncio.Semaphore(max_ongoing_requests)
+        self._ongoing = 0
+        self._total_requests = 0
+        self._test_task: Optional[asyncio.Task] = None
+        self._test_error: Optional[str] = None
+        self._init_done = False
+        self.started_at = time.time()
+        self.last_error: Optional[str] = None
+        self._log_sink = log_sink
+        self.logger = create_logger(f"replica.{self.replica_id}", log_file="off")
+
+    def _log(self, line: str) -> None:
+        self.logger.info(line)
+        if self._log_sink:
+            self._log_sink(self.replica_id, line)
+
+    # ---- lifecycle chain ----------------------------------------------------
+
+    async def start(self) -> None:
+        """Construct the instance and run async_init; schedule the
+        one-shot background test (the reference runs test_deployment in
+        the background and only reports healthy after it passes,
+        ref builder.py:739-890)."""
+        try:
+            self.state = ReplicaState.INITIALIZING
+            self._log("constructing deployment instance")
+            self.instance = self._instance_factory()
+            if hasattr(self.instance, "async_init"):
+                await _maybe_await(self.instance.async_init())
+            self._init_done = True
+            if hasattr(self.instance, "test_deployment"):
+                self.state = ReplicaState.TESTING
+                self._test_task = asyncio.create_task(self._run_test())
+            else:
+                self.state = ReplicaState.HEALTHY
+            self._log(f"replica started (state={self.state})")
+        except Exception as e:
+            self.last_error = "".join(traceback.format_exception(e))[-2000:]
+            self.state = ReplicaState.UNHEALTHY
+            self._log(f"replica start failed: {e}")
+            raise
+
+    async def _run_test(self) -> None:
+        try:
+            self._log("running test_deployment")
+            await _maybe_await(self.instance.test_deployment())
+            self.state = ReplicaState.HEALTHY
+            self._log("test_deployment passed")
+        except Exception as e:
+            self._test_error = "".join(traceback.format_exception(e))[-2000:]
+            self.state = ReplicaState.UNHEALTHY
+            self.last_error = self._test_error
+            self._log(f"test_deployment failed: {e}")
+
+    async def check_health(self) -> ReplicaState:
+        """init done -> test passed -> user check_health."""
+        if self.state in (ReplicaState.STOPPED, ReplicaState.UNHEALTHY):
+            return self.state
+        if not self._init_done:
+            return self.state
+        if self._test_task and not self._test_task.done():
+            return self.state  # still TESTING
+        if self._test_error:
+            return ReplicaState.UNHEALTHY
+        if hasattr(self.instance, "check_health"):
+            try:
+                await _maybe_await(self.instance.check_health())
+                self.state = ReplicaState.HEALTHY
+            except Exception as e:
+                self.last_error = str(e)
+                self.state = ReplicaState.UNHEALTHY
+                self._log(f"user check_health failed: {e}")
+        return self.state
+
+    async def stop(self) -> None:
+        self.state = ReplicaState.STOPPED
+        if self._test_task:
+            self._test_task.cancel()
+        if self.instance is not None and hasattr(self.instance, "close"):
+            try:
+                await _maybe_await(self.instance.close())
+            except Exception as e:
+                self._log(f"close() raised: {e}")
+        self._log("replica stopped")
+
+    # ---- request path -------------------------------------------------------
+
+    async def call(self, method: str, *args, **kwargs) -> Any:
+        """Invoke a method on the instance under the request semaphore.
+        Semaphore occupancy IS the load signal (the reference had to fake
+        HTTP traffic so Ray Serve's autoscaler could see WebRTC load,
+        ref apps/proxy_deployment.py:405-442 — here the controller reads
+        ``load`` directly)."""
+        if self.state != ReplicaState.HEALTHY:
+            raise RuntimeError(
+                f"replica {self.replica_id} not healthy ({self.state})"
+            )
+        fn = getattr(self.instance, method, None)
+        if fn is None:
+            raise AttributeError(
+                f"{self.deployment_name} has no method '{method}'"
+            )
+        async with self._semaphore:
+            self._ongoing += 1
+            self._total_requests += 1
+            try:
+                return await _maybe_await(fn(*args, **kwargs))
+            finally:
+                self._ongoing -= 1
+
+    @property
+    def load(self) -> float:
+        return self._ongoing / max(1, self.max_ongoing_requests)
+
+    def describe(self) -> dict:
+        return {
+            "replica_id": self.replica_id,
+            "deployment": self.deployment_name,
+            "state": self.state.value,
+            "device_ids": self.device_ids,
+            "ongoing_requests": self._ongoing,
+            "total_requests": self._total_requests,
+            "load": self.load,
+            "uptime_seconds": time.time() - self.started_at,
+            "last_error": self.last_error,
+        }
+
+
+async def _maybe_await(value):
+    if asyncio.iscoroutine(value):
+        return await value
+    return value

@@ -47,3 +47,9 @@ def tmp_workspace(tmp_path):
     ws = tmp_path / "workspace"
     ws.mkdir()
     return ws
+
+
+@pytest.fixture(scope="session")
+def anyio_backend():
+    # async tests run via the anyio pytest plugin on plain asyncio
+    return "asyncio"

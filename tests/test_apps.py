@@ -1,0 +1,433 @@
+import asyncio
+from pathlib import Path
+
+import pytest
+import yaml
+
+from bioengine_tpu.apps.artifacts import ArtifactVersionError, LocalArtifactStore
+from bioengine_tpu.apps.builder import AppBuildError, AppBuilder
+from bioengine_tpu.apps.manager import AppsManager
+from bioengine_tpu.apps.manifest import ManifestError, load_manifest, validate_manifest
+from bioengine_tpu.apps.proxy import check_method_permission
+from bioengine_tpu.cluster.state import ClusterState
+from bioengine_tpu.rpc.server import RpcServer
+from bioengine_tpu.serving.controller import ServeController
+from bioengine_tpu.utils.permissions import create_context
+
+pytestmark = [pytest.mark.integration, pytest.mark.anyio]
+
+REPO_APPS = Path(__file__).resolve().parent.parent / "apps"
+
+
+class TestManifest:
+    def test_demo_app_manifest_loads(self):
+        m = load_manifest(REPO_APPS / "demo-app")
+        assert m.id == "demo-app"
+        assert m.entry_deployment.class_name == "DemoDeployment"
+        assert m.deployment_config["demo_deployment"]["max_replicas"] == 2
+
+    def test_missing_fields_rejected(self):
+        with pytest.raises(ManifestError, match="missing"):
+            validate_manifest({"name": "x"})
+
+    def test_bad_type_rejected(self):
+        with pytest.raises(ManifestError, match="type"):
+            validate_manifest(
+                {
+                    "name": "x", "id": "x", "id_emoji": "e",
+                    "description": "d", "type": "docker",
+                    "deployments": ["a:B"],
+                }
+            )
+
+    def test_bad_deployment_format_rejected(self):
+        with pytest.raises(ManifestError, match="file_stem"):
+            validate_manifest(
+                {
+                    "name": "x", "id": "x", "id_emoji": "e",
+                    "description": "d", "type": "tpu-serve",
+                    "deployments": ["no-colon-here"],
+                }
+            )
+
+    def test_ray_serve_type_accepted_for_compat(self):
+        m = validate_manifest(
+            {
+                "name": "x", "id": "x", "id_emoji": "e",
+                "description": "d", "type": "ray-serve",
+                "deployments": ["f:C"],
+            }
+        )
+        assert m.type == "ray-serve"
+
+
+class TestArtifactStore:
+    def test_put_get_roundtrip(self, tmp_path):
+        store = LocalArtifactStore(tmp_path / "store")
+        aid, ver = store.put(REPO_APPS / "demo-app")
+        assert (aid, ver) == ("demo-app", "1.0.0")
+        assert store.list_artifacts() == ["demo-app"]
+        m = store.get_manifest("demo-app")
+        assert m.name == "Demo App"
+        code = store.get_file("demo-app", "demo_deployment.py")
+        assert b"class DemoDeployment" in code
+
+    def test_version_semantics(self, tmp_path):
+        store = LocalArtifactStore(tmp_path / "store")
+        src = tmp_path / "src"
+        src.mkdir()
+        manifest = {
+            "name": "V", "id": "vapp", "id_emoji": "v",
+            "description": "d", "type": "tpu-serve",
+            "deployments": ["m:C"], "version": "1.0.0",
+        }
+        (src / "manifest.yaml").write_text(yaml.safe_dump(manifest))
+        (src / "m.py").write_text("class C: pass")
+
+        store.put(src)                       # create 1.0.0
+        store.put(src)                       # re-save latest in place: ok
+        store.put(src, version="2.0.0")      # new version snapshot
+        assert store.latest_version("vapp") == "2.0.0"
+        assert store.versions("vapp") == ["1.0.0", "2.0.0"]
+        with pytest.raises(ArtifactVersionError, match="older"):
+            store.put(src, version="1.0.0")  # older re-save: error
+
+    def test_delete_version_and_whole(self, tmp_path):
+        store = LocalArtifactStore(tmp_path / "store")
+        store.put(REPO_APPS / "demo-app")
+        store.put(REPO_APPS / "demo-app", version="2.0.0")
+        store.delete("demo-app", "2.0.0")
+        assert store.latest_version("demo-app") == "1.0.0"
+        store.delete("demo-app")
+        assert store.list_artifacts() == []
+
+
+class TestBuilder:
+    def make_builder(self, tmp_path, **kw):
+        return AppBuilder(
+            workdir_root=tmp_path / "workdirs",
+            admin_users=["admin"],
+            log_file="off",
+            **kw,
+        )
+
+    def test_build_demo_from_local_path(self, tmp_path):
+        built = self.make_builder(tmp_path).build(
+            app_id="demo-1", local_path=REPO_APPS / "demo-app"
+        )
+        assert built.entry_name == "demo_deployment"
+        assert set(built.schema_methods) == {"ping", "echo", "get_env"}
+        assert built.specs[0].max_replicas == 2
+        inst = built.specs[0].instance_factory()
+        assert inst.greeting == "Hello"
+        assert inst.workdir == tmp_path / "workdirs" / "demo-1"
+
+    def test_build_from_store(self, tmp_path):
+        store = LocalArtifactStore(tmp_path / "store")
+        store.put(REPO_APPS / "demo-app")
+        built = self.make_builder(tmp_path, store=store).build(
+            app_id="demo-2", artifact_id="demo-app"
+        )
+        assert built.manifest.id == "demo-app"
+
+    def test_kwargs_validated(self, tmp_path):
+        builder = self.make_builder(tmp_path)
+        with pytest.raises(AppBuildError, match="unexpected kwarg"):
+            builder.build(
+                app_id="demo-3",
+                local_path=REPO_APPS / "demo-app",
+                deployment_kwargs={"demo_deployment": {"nope": 1}},
+            )
+
+    def test_kwargs_passed_through(self, tmp_path):
+        built = self.make_builder(tmp_path).build(
+            app_id="demo-4",
+            local_path=REPO_APPS / "demo-app",
+            deployment_kwargs={"demo_deployment": {"greeting": "Hej"}},
+        )
+        assert built.specs[0].instance_factory().greeting == "Hej"
+
+    def test_env_vars_applied(self, tmp_path):
+        import os
+
+        self.make_builder(tmp_path).build(
+            app_id="demo-5",
+            local_path=REPO_APPS / "demo-app",
+            env_vars={"DEMO_TEST_VAR": "42"},
+        )
+        assert os.environ["DEMO_TEST_VAR"] == "42"
+
+    def test_authorized_users_resolution(self, tmp_path):
+        built = self.make_builder(tmp_path).build(
+            app_id="demo-6",
+            local_path=REPO_APPS / "demo-app",
+            authorized_users_override=["alice"],
+            deployer="bob",
+        )
+        assert built.authorized_users == ["alice", "admin", "bob"]
+
+    def test_composition_entry_deployed_last(self, tmp_path):
+        built = self.make_builder(tmp_path).build(
+            app_id="comp-1",
+            local_path=REPO_APPS / "composition-demo",
+            make_handle=lambda name: f"handle:{name}",
+        )
+        assert [s.name for s in built.specs] == [
+            "runtime_a", "runtime_b", "entry_deployment",
+        ]
+        entry = built.specs[-1].instance_factory()
+        assert entry.runtime_a == "handle:runtime_a"
+
+    def test_missing_required_kwarg_fails_build(self, tmp_path):
+        src = tmp_path / "strict-app"
+        src.mkdir()
+        (src / "manifest.yaml").write_text(
+            yaml.safe_dump(
+                {
+                    "name": "S", "id": "strict", "id_emoji": "s",
+                    "description": "d", "type": "tpu-serve",
+                    "deployments": ["m:Strict"],
+                }
+            )
+        )
+        (src / "m.py").write_text(
+            "from bioengine_tpu.rpc import schema_method\n"
+            "class Strict:\n"
+            "    def __init__(self, required_thing): pass\n"
+            "    @schema_method\n"
+            "    def go(self): pass\n"
+        )
+        with pytest.raises(AppBuildError, match="missing required"):
+            self.make_builder(tmp_path).build(app_id="s1", local_path=src)
+
+    def test_no_schema_methods_fails_build(self, tmp_path):
+        src = tmp_path / "bare-app"
+        src.mkdir()
+        (src / "manifest.yaml").write_text(
+            yaml.safe_dump(
+                {
+                    "name": "B", "id": "bare", "id_emoji": "b",
+                    "description": "d", "type": "tpu-serve",
+                    "deployments": ["m:Bare"],
+                }
+            )
+        )
+        (src / "m.py").write_text("class Bare:\n    def f(self): pass\n")
+        with pytest.raises(AppBuildError, match="schema_method"):
+            self.make_builder(tmp_path).build(app_id="b1", local_path=src)
+
+
+class TestMethodAcl:
+    def test_flat_list(self):
+        check_method_permission(["alice"], "infer", create_context("alice"))
+        with pytest.raises(PermissionError):
+            check_method_permission(["alice"], "infer", create_context("eve"))
+
+    def test_per_method_beats_wildcard(self):
+        acl = {"train": ["alice"], "*": ["*"]}
+        check_method_permission(acl, "infer", create_context("anyone"))
+        with pytest.raises(PermissionError):
+            check_method_permission(acl, "train", create_context("eve"))
+        check_method_permission(acl, "train", create_context("alice"))
+
+    def test_no_entry_denies(self):
+        with pytest.raises(PermissionError):
+            check_method_permission({"x": ["a"]}, "infer", create_context("a"))
+
+
+@pytest.fixture
+async def stack(tmp_path):
+    """controller + rpc server + manager wired together (in-process)."""
+    server = RpcServer(admin_users=["admin"])
+    await server.start()
+    controller = ServeController(ClusterState(), health_check_period=3600)
+    store = LocalArtifactStore(tmp_path / "store")
+    builder = AppBuilder(
+        store=store, workdir_root=tmp_path / "workdirs",
+        admin_users=["admin"], log_file="off",
+    )
+    manager = AppsManager(
+        controller=controller,
+        server=server,
+        store=store,
+        builder=builder,
+        admin_users=["admin"],
+        log_file="off",
+    )
+    yield manager, controller, server, store
+    await controller.stop()
+    await server.stop()
+
+
+ADMIN = create_context("admin")
+
+
+class TestAppsManager:
+    async def test_deploy_call_stop(self, stack):
+        manager, controller, server, _ = stack
+        result = await manager.deploy_app(
+            local_path=str(REPO_APPS / "demo-app"), context=ADMIN
+        )
+        app_id = result["app_id"]
+        assert "-" in app_id  # generated two-word id
+        await asyncio.sleep(0.05)
+
+        # call through the registered RPC service with context injection
+        out = await server.call_service_method(
+            result["service_id"], "echo",
+            kwargs={"message": "hi"},
+            caller=server.validate_token(server.issue_token("anyone")),
+        )
+        assert out["echo"] == "hi"
+
+        status = manager.get_app_status(app_id)
+        assert status["status"] == "RUNNING"
+        assert status["available_methods"] == ["echo", "get_env", "ping"]
+
+        await manager.stop_app(app_id, context=ADMIN)
+        assert app_id not in manager.records
+        assert not any(
+            s["id"].endswith(app_id) for s in server.list_services()
+        )
+
+    async def test_deploy_requires_admin(self, stack):
+        manager, *_ = stack
+        with pytest.raises(PermissionError):
+            await manager.deploy_app(
+                local_path=str(REPO_APPS / "demo-app"),
+                context=create_context("eve"),
+            )
+
+    async def test_method_acl_enforced_through_service(self, stack, tmp_path):
+        manager, _, server, _ = stack
+        result = await manager.deploy_app(
+            local_path=str(REPO_APPS / "demo-app"),
+            authorized_users=["alice"],
+            context=ADMIN,
+        )
+        await asyncio.sleep(0.05)
+        caller = server.validate_token(server.issue_token("eve"))
+        with pytest.raises(PermissionError):
+            await server.call_service_method(
+                result["service_id"], "ping", caller=caller
+            )
+        alice = server.validate_token(server.issue_token("alice"))
+        out = await server.call_service_method(
+            result["service_id"], "ping", caller=alice
+        )
+        assert out["pong"]
+
+    async def test_composition_app_end_to_end(self, stack):
+        manager, _, server, _ = stack
+        result = await manager.deploy_app(
+            local_path=str(REPO_APPS / "composition-demo"), context=ADMIN
+        )
+        await asyncio.sleep(0.05)
+        out = await server.call_service_method(
+            result["service_id"], "fan_out",
+            kwargs={"value": 5},
+            caller=server.validate_token(server.issue_token("u")),
+        )
+        assert out == {"a": 10, "b": 105, "sum": 115}
+
+    async def test_update_redeploys_same_id(self, stack):
+        manager, *_ = stack
+        r1 = await manager.deploy_app(
+            local_path=str(REPO_APPS / "demo-app"), context=ADMIN
+        )
+        r2 = await manager.deploy_app(
+            local_path=str(REPO_APPS / "demo-app"),
+            app_id=r1["app_id"],
+            deployment_kwargs={"demo_deployment": {"greeting": "Updated"}},
+            context=ADMIN,
+        )
+        assert r2["app_id"] == r1["app_id"]
+        assert len(manager.records) == 1
+
+    async def test_upload_and_deploy_from_store(self, stack):
+        manager, *_ = stack
+        up = manager.upload_app(str(REPO_APPS / "demo-app"), context=ADMIN)
+        assert up == {"artifact_id": "demo-app", "version": "1.0.0"}
+        result = await manager.deploy_app(
+            artifact_id="demo-app", context=ADMIN
+        )
+        assert result["name"] == "Demo App"
+        apps = manager.list_apps(context=ADMIN)
+        assert apps[0]["artifact_id"] == "demo-app"
+
+    async def test_status_masks_secret_env_keys(self, stack):
+        manager, *_ = stack
+        result = await manager.deploy_app(
+            local_path=str(REPO_APPS / "demo-app"),
+            env_vars={"_SECRET_KEY": "sensitive", "PLAIN": "ok"},
+            context=ADMIN,
+        )
+        status = manager.get_app_status(result["app_id"])
+        assert "_SECRET_KEY (masked)" in status["env_keys"]
+        assert "PLAIN" in status["env_keys"]
+        assert "sensitive" not in str(status)
+
+    async def test_app_directories_listing_and_clear(self, stack):
+        manager, *_ = stack
+        result = await manager.deploy_app(
+            local_path=str(REPO_APPS / "demo-app"), context=ADMIN
+        )
+        dirs = manager.list_app_directories(context=ADMIN)
+        assert any(d["app_id"] == result["app_id"] and d["in_use"] for d in dirs)
+        with pytest.raises(RuntimeError, match="deployed"):
+            manager.clear_app_directory(result["app_id"], context=ADMIN)
+        await manager.stop_app(result["app_id"], context=ADMIN)
+        out = manager.clear_app_directory(result["app_id"], context=ADMIN)
+        assert out["cleared"]
+
+    async def test_monitor_deregisters_unhealthy(self, stack):
+        manager, controller, server, _ = stack
+        result = await manager.deploy_app(
+            local_path=str(REPO_APPS / "demo-app"), context=ADMIN
+        )
+        app_id = result["app_id"]
+        await asyncio.sleep(0.05)
+        # force unhealthy
+        controller.apps[app_id].status = "UNHEALTHY"
+        await manager.monitor_applications()
+        assert not manager.records[app_id].proxy.registered
+        # back to running -> re-register
+        controller.apps[app_id].status = "RUNNING"
+        await manager.monitor_applications()
+        assert manager.records[app_id].proxy.registered
+
+    async def test_startup_applications(self, stack):
+        manager, *_ = stack
+        results = await manager.deploy_startup_applications(
+            [
+                {"local_path": str(REPO_APPS / "demo-app")},
+                {"local_path": "/nonexistent/path"},
+            ]
+        )
+        assert "app_id" in results[0]
+        assert "error" in results[1]
+
+
+class TestAutoRedeployPreservesOverrides:
+    async def test_acl_survives_auto_redeploy(self, stack):
+        manager, controller, server, _ = stack
+        result = await manager.deploy_app(
+            local_path=str(REPO_APPS / "demo-app"),
+            authorized_users=["alice"],
+            auto_redeploy=True,
+            context=ADMIN,
+        )
+        app_id = result["app_id"]
+        await asyncio.sleep(0.05)
+        controller.apps[app_id].status = "UNHEALTHY"
+        await manager.monitor_applications()
+        await asyncio.sleep(0.05)
+        # after the automatic redeploy the restricted ACL must still hold
+        record = manager.records[app_id]
+        assert "alice" in record.built.authorized_users
+        assert "*" not in record.built.authorized_users
+        eve = server.validate_token(server.issue_token("eve"))
+        with pytest.raises(PermissionError):
+            await server.call_service_method(
+                record.proxy.service_id, "ping", caller=eve
+            )

@@ -1,0 +1,232 @@
+import os
+import subprocess
+import time
+
+import pytest
+
+from bioengine_tpu.cluster.cluster import ClusterLockError, TpuCluster
+from bioengine_tpu.cluster.provisioner import (
+    NullProvisioner,
+    ScalingPolicy,
+    SlurmProvisioner,
+)
+from bioengine_tpu.cluster.state import ClusterState, PendingWorkload
+from bioengine_tpu.cluster.topology import detect_topology
+
+pytestmark = pytest.mark.unit
+
+
+class FakeRunner:
+    """Records commands; scripted stdout per verb."""
+
+    def __init__(self):
+        self.commands = []
+        self.job_states: dict[str, str] = {}
+        self._next_id = 100
+
+    def __call__(self, cmd):
+        self.commands.append(cmd)
+        verb = cmd[0]
+        if verb == "sbatch":
+            job_id = str(self._next_id)
+            self._next_id += 1
+            self.job_states[job_id] = "RUNNING"
+            return subprocess.CompletedProcess(cmd, 0, stdout=f"{job_id}\n", stderr="")
+        if verb == "squeue":
+            job_id = cmd[cmd.index("-j") + 1]
+            state = self.job_states.get(job_id, "")
+            return subprocess.CompletedProcess(cmd, 0, stdout=f"{state}\n", stderr="")
+        if verb == "scancel":
+            self.job_states.pop(cmd[1], None)
+            return subprocess.CompletedProcess(cmd, 0, stdout="", stderr="")
+        return subprocess.CompletedProcess(cmd, 0, stdout="", stderr="")
+
+
+class TestTopology:
+    def test_detect_on_cpu_backend(self):
+        topo = detect_topology()
+        assert topo.n_chips == 8  # virtual CPU devices from conftest
+        assert topo.platform == "cpu"
+        assert topo.default_mesh_axes() == {"dp": 8}
+
+    def test_as_dict_shape(self):
+        d = detect_topology().as_dict()
+        assert set(d) == {"platform", "n_chips", "n_hosts", "chips"}
+        assert len(d["chips"]) == d["n_chips"]
+
+
+class TestClusterState:
+    def test_snapshot_and_history_ring(self):
+        state = ClusterState()
+        for _ in range(105):
+            state.snapshot()
+        assert len(state.history()) == 100
+        snap = state.history()[-1]
+        assert snap["n_chips_free"] == 8
+
+    def test_chip_accounting(self):
+        state = ClusterState()
+        taken = state.acquire_chips("replica-1", 3)
+        assert len(taken) == 3
+        assert state.free_chips() == 5
+        with pytest.raises(RuntimeError):
+            state.acquire_chips("replica-2", 6)
+        state.release_chips("replica-1")
+        assert state.free_chips() == 8
+
+    def test_replica_registry_and_dead_logs(self):
+        state = ClusterState()
+        state.register_replica("app-1", "entry", "r1", [0])
+        state.append_replica_log("r1", "hello")
+        state.append_replica_log("r1", "world")
+        state.mark_replica_dead("r1")
+        logs = state.get_replica_logs("app-1")
+        assert list(logs) == ["entry/r1 (dead)"]
+        assert logs["entry/r1 (dead)"] == ["hello", "world"]
+        assert state.get_replica_logs("app-1", include_dead=False) == {}
+
+    def test_pending_queue(self):
+        state = ClusterState()
+        state.add_pending("w1", {"chips": 2})
+        assert [p.workload_id for p in state.pending()] == ["w1"]
+        state.remove_pending("w1")
+        assert state.pending() == []
+
+
+class TestSlurmProvisioner:
+    def make(self, **kw):
+        runner = FakeRunner()
+        policy = ScalingPolicy(
+            max_workers=2, cooldown_seconds=0.0, idle_window_snapshots=3
+        )
+        prov = SlurmProvisioner(runner=runner, policy=policy, **kw)
+        return prov, runner
+
+    def pending(self, n=1):
+        return [
+            PendingWorkload(f"w{i}", {"chips": 4, "cpus": 8}, time.time())
+            for i in range(n)
+        ]
+
+    def test_scale_up_on_pending(self):
+        prov, runner = self.make()
+        actions = prov.check_scaling(self.pending(), [])
+        assert len(actions["scaled_up"]) == 1
+        assert runner.commands[0][0] == "sbatch"
+        w = prov.active_workers()[0]
+        assert w.resources["chips"] == 4
+
+    def test_max_workers_cap(self):
+        prov, _ = self.make()
+        prov.check_scaling(self.pending(), [])
+        prov.check_scaling(self.pending(), [])
+        actions = prov.check_scaling(self.pending(), [])
+        assert actions["scaled_up"] == []
+        assert len(prov.active_workers()) == 2
+
+    def test_cooldown_blocks_rapid_scale_up(self):
+        runner = FakeRunner()
+        prov = SlurmProvisioner(
+            runner=runner,
+            policy=ScalingPolicy(max_workers=5, cooldown_seconds=9999),
+        )
+        prov.check_scaling(self.pending(), [])
+        actions = prov.check_scaling(self.pending(), [])
+        assert actions["scaled_up"] == []
+
+    def test_scale_down_requires_full_idle_window(self):
+        prov, runner = self.make()
+        prov.check_scaling(self.pending(), [])
+        worker_id = prov.active_workers()[0].worker_id
+        # idle but history window too short: no scale-down
+        actions = prov.check_scaling([], [{}], {worker_id})
+        assert actions["scaled_down"] == []
+        # full window: scale down
+        actions = prov.check_scaling([], [{}] * 3, {worker_id})
+        assert actions["scaled_down"] == [worker_id]
+        assert any(c[0] == "scancel" for c in runner.commands)
+
+    def test_sbatch_script_contents(self):
+        prov, _ = self.make(
+            partition="tpu-v5e", container_image="bioengine.sif"
+        )
+        script = prov.build_sbatch_script({"cpus": 4, "memory_gb": 16}, "abc")
+        assert "#SBATCH --partition=tpu-v5e" in script
+        assert "#SBATCH --cpus-per-task=4" in script
+        assert "#SBATCH --mem=16G" in script
+        assert "apptainer exec" in script
+        assert "--worker-tag abc" in script
+
+    def test_close_all_cancels(self):
+        prov, runner = self.make()
+        prov.check_scaling(self.pending(), [])
+        prov.close_all()
+        assert prov.active_workers() == []
+        assert any(c[0] == "scancel" for c in runner.commands)
+
+
+class TestTpuCluster:
+    def test_start_stop_and_status(self, tmp_path):
+        cluster = TpuCluster(
+            mode="single-machine", workspace_dir=tmp_path, log_file="off"
+        )
+        cluster.start()
+        try:
+            assert cluster.is_ready
+            assert cluster.check_connection()
+            st = cluster.status
+            assert st["mode"] == "single-machine"
+            assert st["topology"]["n_chips"] == 8
+            actions = cluster.monitor_cluster()
+            assert actions == {"scaled_up": [], "scaled_down": []}
+        finally:
+            cluster.stop()
+        assert not cluster.is_ready
+        assert not (tmp_path / "cluster.lock").exists()
+
+    def test_lock_prevents_second_manager(self, tmp_path):
+        c1 = TpuCluster(mode="single-machine", workspace_dir=tmp_path, log_file="off")
+        c1.start()
+        try:
+            c2 = TpuCluster(
+                mode="single-machine", workspace_dir=tmp_path, log_file="off"
+            )
+            with pytest.raises(ClusterLockError):
+                c2.start()
+        finally:
+            c1.stop()
+
+    def test_stale_lock_reclaimed(self, tmp_path):
+        (tmp_path / "cluster.lock").write_text("999999999")
+        cluster = TpuCluster(
+            mode="single-machine", workspace_dir=tmp_path, log_file="off"
+        )
+        cluster.start()
+        try:
+            assert cluster.is_ready
+            assert (tmp_path / "cluster.lock").read_text() == str(os.getpid())
+        finally:
+            cluster.stop()
+
+    def test_invalid_mode_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="mode"):
+            TpuCluster(mode="kubernetes", workspace_dir=tmp_path)
+
+    def test_slurm_mode_uses_provisioner(self, tmp_path):
+        runner = FakeRunner()
+        prov = SlurmProvisioner(
+            runner=runner, policy=ScalingPolicy(cooldown_seconds=0)
+        )
+        cluster = TpuCluster(
+            mode="slurm",
+            workspace_dir=tmp_path,
+            provisioner=prov,
+            log_file="off",
+        )
+        cluster.start()
+        try:
+            cluster.state.add_pending("w1", {"chips": 8})
+            actions = cluster.monitor_cluster()
+            assert len(actions["scaled_up"]) == 1
+        finally:
+            cluster.stop()

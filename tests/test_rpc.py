@@ -1,0 +1,208 @@
+import asyncio
+
+import numpy as np
+import pytest
+
+from bioengine_tpu.rpc.client import connect_to_server
+from bioengine_tpu.rpc.protocol import RemoteError, decode, encode
+from bioengine_tpu.rpc.schema import extract_schema, schema_method
+from bioengine_tpu.rpc.server import RpcServer
+
+pytestmark = [pytest.mark.integration, pytest.mark.anyio]
+
+
+class TestProtocol:
+    def test_roundtrip_basic(self):
+        msg = {"t": "call", "args": [1, "x", 2.5, None, True], "kwargs": {"a": [1, 2]}}
+        assert decode(encode(msg)) == msg
+
+    def test_roundtrip_ndarray(self):
+        arr = np.random.rand(3, 4).astype(np.float32)
+        out = decode(encode({"r": arr}))["r"]
+        np.testing.assert_array_equal(out, arr)
+        assert out.dtype == np.float32
+
+    def test_roundtrip_exception(self):
+        err = decode(encode({"e": ValueError("boom")}))["e"]
+        assert isinstance(err, RemoteError)
+        assert "boom" in str(err)
+
+
+class TestSchema:
+    def test_extract_schema(self):
+        @schema_method
+        def infer(model_id: str, batch: int = 4, context=None):
+            """Run inference."""
+
+        s = infer.__schema__
+        assert s["name"] == "infer"
+        assert s["description"] == "Run inference."
+        assert s["parameters"]["required"] == ["model_id"]
+        assert "context" not in s["parameters"]["properties"]
+        assert s["parameters"]["properties"]["batch"]["default"] == 4
+
+    def test_plain_function_schema(self):
+        def f(x, y=1):
+            pass
+
+        s = extract_schema(f)
+        assert set(s["parameters"]["properties"]) == {"x", "y"}
+
+
+@pytest.fixture
+async def server():
+    srv = RpcServer(admin_users=["admin"])
+    await srv.start()
+    yield srv
+    await srv.stop()
+
+
+@pytest.fixture
+async def admin_conn(server):
+    token = server.issue_token("admin")
+    conn = await connect_to_server(
+        {"server_url": f"http://127.0.0.1:{server.port}", "token": token}
+    )
+    yield conn
+    await conn.disconnect()
+
+
+class TestServer:
+    async def test_local_service_call_with_context(self, server):
+        seen = {}
+
+        def who_am_i(context=None):
+            seen.update(context)
+            return context["user"]["id"]
+
+        server.register_local_service(
+            {
+                "id": "test-svc",
+                "config": {"require_context": True},
+                "who_am_i": who_am_i,
+            }
+        )
+        info = server.issue_token("alice")
+        result = await server.call_service_method(
+            "test-svc", "who_am_i", caller=server.validate_token(info)
+        )
+        assert result == "alice"
+        assert seen["ws"] == "bioengine"
+
+    async def test_expired_token_rejected(self, server):
+        token = server.issue_token("bob", ttl_seconds=-1)
+        with pytest.raises(PermissionError, match="expired"):
+            server.validate_token(token)
+
+    async def test_unknown_token_rejected(self, server):
+        with pytest.raises(PermissionError):
+            server.validate_token("nope")
+
+    async def test_remote_client_registers_and_serves(self, server, admin_conn):
+        calls = []
+
+        @schema_method
+        async def echo(value, context=None):
+            """Echo a value back."""
+            calls.append(value)
+            return {"echoed": value}
+
+        svc = await admin_conn.register_service(
+            {
+                "id": "echo-svc",
+                "name": "Echo",
+                "config": {"require_context": True},
+                "echo": echo,
+            }
+        )
+        assert svc["id"] == "bioengine/echo-svc"
+
+        # second client calls through the server
+        conn2 = await connect_to_server(
+            {"server_url": f"http://127.0.0.1:{server.port}"}
+        )
+        try:
+            proxy = await conn2.get_service("echo-svc")
+            out = await proxy.echo(value=42)
+            assert out == {"echoed": 42}
+            assert calls == [42]
+        finally:
+            await conn2.disconnect()
+
+    async def test_ndarray_over_the_wire(self, server, admin_conn):
+        async def double(arr):
+            return arr * 2
+
+        await admin_conn.register_service({"id": "math-svc", "double": double})
+        conn2 = await connect_to_server(
+            {"server_url": f"http://127.0.0.1:{server.port}"}
+        )
+        try:
+            arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+            out = await conn2.call("bioengine/math-svc", "double", arr)
+            np.testing.assert_array_equal(out, arr * 2)
+        finally:
+            await conn2.disconnect()
+
+    async def test_remote_error_propagates(self, server, admin_conn):
+        async def fail():
+            raise ValueError("deliberate")
+
+        await admin_conn.register_service({"id": "fail-svc", "fail": fail})
+        with pytest.raises(RemoteError, match="deliberate"):
+            await admin_conn.call("bioengine/fail-svc", "fail")
+
+    async def test_generate_token_requires_admin(self, server):
+        conn = await connect_to_server(
+            {"server_url": f"http://127.0.0.1:{server.port}"}
+        )
+        try:
+            with pytest.raises(Exception, match="admin"):
+                await conn.generate_token()
+        finally:
+            await conn.disconnect()
+
+    async def test_admin_generates_token_for_user(self, server, admin_conn):
+        token = await admin_conn.generate_token({"user_id": "app-1"})
+        info = server.validate_token(token)
+        assert info.user_id == "app-1"
+        assert not info.is_admin
+
+    async def test_service_dropped_on_disconnect(self, server, admin_conn):
+        conn2 = await connect_to_server(
+            {"server_url": f"http://127.0.0.1:{server.port}"}
+        )
+        await conn2.register_service({"id": "ephemeral", "f": lambda: 1})
+        assert any(
+            s["id"] == "bioengine/ephemeral" for s in server.list_services()
+        )
+        await conn2.disconnect()
+        await asyncio.sleep(0.2)
+        assert not any(
+            s["id"] == "bioengine/ephemeral" for s in server.list_services()
+        )
+
+    async def test_ping(self, admin_conn):
+        ts = await admin_conn.ping()
+        assert ts > 0
+
+    async def test_list_services_shapes(self, server, admin_conn):
+        @schema_method
+        def m(x: int):
+            """Doc."""
+
+        await admin_conn.register_service({"id": "s1", "name": "S1", "m": m})
+        services = await admin_conn.list_services()
+        s1 = next(s for s in services if s["id"] == "bioengine/s1")
+        assert s1["name"] == "S1"
+        assert "m" in s1["methods"]
+
+
+class TestTokenIdentityFallback:
+    async def test_generate_token_defaults_to_caller_identity(
+        self, server, admin_conn
+    ):
+        token = await admin_conn.generate_token({})
+        info = server.validate_token(token)
+        assert info.user_id == "admin"
+        assert info.workspace == "bioengine"

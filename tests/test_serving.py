@@ -1,0 +1,335 @@
+import asyncio
+
+import pytest
+
+from bioengine_tpu.cluster.state import ClusterState
+from bioengine_tpu.serving import (
+    ContinuousBatcher,
+    DeploymentSpec,
+    ReplicaState,
+    ServeController,
+)
+
+pytestmark = [pytest.mark.integration, pytest.mark.anyio]
+
+
+class GoodApp:
+    def __init__(self):
+        self.initialized = False
+        self.tested = False
+        self.health_checks = 0
+
+    async def async_init(self):
+        self.initialized = True
+
+    async def test_deployment(self):
+        self.tested = True
+
+    async def check_health(self):
+        self.health_checks += 1
+
+    async def echo(self, value):
+        return {"echo": value}
+
+    def sync_add(self, a, b):
+        return a + b
+
+
+class FailingTestApp(GoodApp):
+    async def test_deployment(self):
+        raise RuntimeError("self-test exploded")
+
+
+class CrashingInitApp:
+    def __init__(self):
+        raise RuntimeError("init boom")
+
+
+class FlakyApp(GoodApp):
+    """Healthy until told otherwise."""
+
+    broken = False
+
+    async def check_health(self):
+        if FlakyApp.broken:
+            raise RuntimeError("went bad")
+
+
+@pytest.fixture
+async def controller():
+    c = ServeController(ClusterState(), health_check_period=3600)
+    yield c
+    await c.stop()
+
+
+class TestDeployLifecycle:
+    async def test_deploy_and_call(self, controller):
+        await controller.deploy(
+            "app-1", [DeploymentSpec(name="entry", instance_factory=GoodApp)]
+        )
+        await asyncio.sleep(0.05)  # let background test finish
+        handle = controller.get_handle("app-1")
+        assert await handle.echo(value=5) == {"echo": 5}
+        assert await handle.call("sync_add", 2, 3) == 5
+        status = controller.get_app_status("app-1")
+        assert status["status"] == "RUNNING"
+        rep = status["deployments"]["entry"]["replicas"][0]
+        assert rep["state"] == "HEALTHY"
+        assert rep["total_requests"] == 2
+
+    async def test_lifecycle_chain_ran(self, controller):
+        app = await controller.deploy(
+            "app-2", [DeploymentSpec(name="entry", instance_factory=GoodApp)]
+        )
+        await asyncio.sleep(0.05)
+        inst = app.replicas["entry"][0].instance
+        assert inst.initialized and inst.tested
+        await controller.health_tick()
+        assert inst.health_checks == 1
+
+    async def test_failed_self_test_marks_unhealthy(self, controller):
+        app = await controller.deploy(
+            "app-3",
+            [
+                DeploymentSpec(
+                    name="entry", instance_factory=FailingTestApp, autoscale=False
+                )
+            ],
+        )
+        await asyncio.sleep(0.05)
+        r = app.replicas["entry"][0]
+        assert r.state == ReplicaState.UNHEALTHY
+        with pytest.raises(RuntimeError, match="not healthy"):
+            await r.call("echo", value=1)
+
+    async def test_crashing_init_fails_deploy(self, controller):
+        with pytest.raises(RuntimeError, match="init boom"):
+            await controller.deploy(
+                "app-4",
+                [DeploymentSpec(name="entry", instance_factory=CrashingInitApp)],
+            )
+        assert controller.apps["app-4"].status == "DEPLOY_FAILED"
+
+    async def test_undeploy_releases(self, controller):
+        await controller.deploy(
+            "app-5", [DeploymentSpec(name="entry", instance_factory=GoodApp)]
+        )
+        await controller.undeploy("app-5")
+        assert "app-5" not in controller.list_apps()
+        with pytest.raises(KeyError):
+            controller.get_handle("app-5")
+
+
+class TestHealthRestart:
+    async def test_unhealthy_replica_restarted(self, controller):
+        FlakyApp.broken = False
+        app = await controller.deploy(
+            "app-6",
+            [DeploymentSpec(name="entry", instance_factory=FlakyApp)],
+        )
+        await asyncio.sleep(0.05)
+        old_id = app.replicas["entry"][0].replica_id
+        FlakyApp.broken = True
+        await controller.health_tick()   # detects + restarts
+        FlakyApp.broken = False
+        await asyncio.sleep(0.05)
+        await controller.health_tick()
+        new = app.replicas["entry"][0]
+        assert new.replica_id != old_id
+        assert new.state == ReplicaState.HEALTHY
+        # dead replica logs retrievable (parity with dead-replica logs)
+        logs = controller.cluster_state.get_replica_logs("app-6")
+        assert any("(dead)" in k for k in logs)
+
+
+class TestChipAccounting:
+    async def test_chips_leased_and_released(self, controller):
+        state = controller.cluster_state
+        await controller.deploy(
+            "app-7",
+            [
+                DeploymentSpec(
+                    name="rt",
+                    instance_factory=GoodApp,
+                    chips_per_replica=4,
+                    autoscale=False,
+                )
+            ],
+        )
+        assert state.free_chips() == 4
+        await controller.undeploy("app-7")
+        assert state.free_chips() == 8
+
+    async def test_no_capacity_enqueues_pending(self, controller):
+        state = controller.cluster_state
+        with pytest.raises(RuntimeError, match="chips"):
+            await controller.deploy(
+                "app-8",
+                [
+                    DeploymentSpec(
+                        name="rt",
+                        instance_factory=GoodApp,
+                        chips_per_replica=16,  # more than the 8 available
+                    )
+                ],
+            )
+        assert [p.workload_id for p in state.pending()] == ["app-8/rt"]
+
+
+class TestAutoscale:
+    async def test_scale_up_under_load(self, controller):
+        class SlowApp(GoodApp):
+            async def slow(self):
+                await asyncio.sleep(0.3)
+                return "done"
+
+        app = await controller.deploy(
+            "app-9",
+            [
+                DeploymentSpec(
+                    name="entry",
+                    instance_factory=SlowApp,
+                    max_ongoing_requests=2,
+                    max_replicas=3,
+                    target_load=0.4,
+                )
+            ],
+        )
+        await asyncio.sleep(0.05)
+        handle = controller.get_handle("app-9")
+        tasks = [asyncio.create_task(handle.slow()) for _ in range(4)]
+        await asyncio.sleep(0.1)  # requests in flight -> load = 1.0
+        await controller.health_tick()
+        assert len(app.replicas["entry"]) == 2
+        await asyncio.gather(*tasks)
+
+    async def test_scale_down_when_idle(self, controller):
+        app = await controller.deploy(
+            "app-10",
+            [
+                DeploymentSpec(
+                    name="entry",
+                    instance_factory=GoodApp,
+                    num_replicas=2,
+                    min_replicas=1,
+                )
+            ],
+        )
+        await asyncio.sleep(0.05)
+        await controller.health_tick()
+        assert len(app.replicas["entry"]) == 1
+
+
+class TestBatcher:
+    async def test_batches_by_signature(self):
+        seen = []
+
+        async def batch_fn(sig, payloads):
+            seen.append((sig, list(payloads)))
+            return [p * 2 for p in payloads]
+
+        b = ContinuousBatcher(batch_fn, max_batch=4, max_wait_ms=20)
+        results = await asyncio.gather(
+            *(b.submit("bucket-a", i) for i in range(4))
+        )
+        assert results == [0, 2, 4, 6]
+        assert len(seen) == 1 and len(seen[0][1]) == 4  # one full batch
+
+    async def test_timeout_flush_partial(self):
+        async def batch_fn(sig, payloads):
+            return payloads
+
+        b = ContinuousBatcher(batch_fn, max_batch=100, max_wait_ms=10)
+        out = await b.submit("s", "only-one")
+        assert out == "only-one"
+        assert b.stats["batches"] == 1
+
+    async def test_different_signatures_not_mixed(self):
+        calls = []
+
+        async def batch_fn(sig, payloads):
+            calls.append(sig)
+            return payloads
+
+        b = ContinuousBatcher(batch_fn, max_batch=2, max_wait_ms=5)
+        await asyncio.gather(
+            b.submit("a", 1), b.submit("b", 2), b.submit("a", 3), b.submit("b", 4)
+        )
+        assert sorted(calls) == ["a", "b"]
+
+    async def test_batch_error_propagates_to_all(self):
+        async def batch_fn(sig, payloads):
+            raise ValueError("bad batch")
+
+        b = ContinuousBatcher(batch_fn, max_batch=2, max_wait_ms=5)
+        with pytest.raises(ValueError, match="bad batch"):
+            await asyncio.gather(b.submit("s", 1), b.submit("s", 2))
+
+    async def test_result_count_mismatch_detected(self):
+        async def batch_fn(sig, payloads):
+            return payloads[:-1]
+
+        b = ContinuousBatcher(batch_fn, max_batch=2, max_wait_ms=5)
+        with pytest.raises(RuntimeError, match="results"):
+            await asyncio.gather(b.submit("s", 1), b.submit("s", 2))
+
+    async def test_close_flushes(self):
+        async def batch_fn(sig, payloads):
+            return payloads
+
+        b = ContinuousBatcher(batch_fn, max_batch=100, max_wait_ms=60_000)
+        task = asyncio.create_task(b.submit("s", 7))
+        await asyncio.sleep(0.02)
+        await b.close()
+        assert await task == 7
+
+
+class TestRegressionFixes:
+    async def test_submit_during_inflight_flush_gets_timer(self):
+        """A request arriving while batch_fn for its signature is mid-
+        flight must not wait forever (regression: timer registration)."""
+        import anyio
+
+        release = asyncio.Event()
+
+        async def batch_fn(sig, payloads):
+            if not release.is_set():
+                release.set()
+                await asyncio.sleep(0.05)  # hold the first flush open
+            return payloads
+
+        b = ContinuousBatcher(batch_fn, max_batch=100, max_wait_ms=5)
+        t1 = asyncio.create_task(b.submit("s", 1))
+        await release.wait()               # first flush is now in batch_fn
+        t2 = asyncio.create_task(b.submit("s", 2))
+        with anyio.fail_after(2):
+            assert await t1 == 1
+            assert await t2 == 2
+
+    async def test_failed_deploy_releases_chips_and_allows_retry(self, controller):
+        calls = {"n": 0}
+
+        class SecondFails:
+            def __init__(self):
+                calls["n"] += 1
+                if calls["n"] == 2:
+                    raise RuntimeError("second replica boom")
+
+            async def ping(self):
+                return "ok"
+
+        specs = [
+            DeploymentSpec(
+                name="rt",
+                instance_factory=SecondFails,
+                num_replicas=2,
+                chips_per_replica=2,
+                autoscale=False,
+            )
+        ]
+        with pytest.raises(RuntimeError, match="boom"):
+            await controller.deploy("app-fail", specs)
+        # chips released, id reusable
+        assert controller.cluster_state.free_chips() == 8
+        app = await controller.deploy("app-fail", specs)  # third ctor call OK
+        assert app.status == "RUNNING"
