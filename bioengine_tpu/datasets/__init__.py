@@ -1,0 +1,30 @@
+"""Dataset streaming plane: zarr-over-HTTP with LRU caching + TPU prefetch.
+
+Replaces ref bioengine/datasets/ (client, HttpZarrStore, ChunkCache,
+proxy server) with a self-contained implementation — including our own
+zarr v2/v3 codec layer (no external ``zarr`` dependency) and a new
+device-prefetch path for feeding pjit programs.
+"""
+
+from bioengine_tpu.datasets.chunk_cache import ChunkCache, default_cache
+from bioengine_tpu.datasets.datasets import BioEngineDatasets
+from bioengine_tpu.datasets.http_zarr_store import (
+    HttpZarrStore,
+    RemoteZarrArray,
+    RemoteZarrGroup,
+)
+from bioengine_tpu.datasets.prefetch import ZarrBatchLoader, prefetch_to_device
+from bioengine_tpu.datasets.proxy_server import DatasetsServer, start_proxy_server
+
+__all__ = [
+    "BioEngineDatasets",
+    "ChunkCache",
+    "DatasetsServer",
+    "HttpZarrStore",
+    "RemoteZarrArray",
+    "RemoteZarrGroup",
+    "ZarrBatchLoader",
+    "default_cache",
+    "prefetch_to_device",
+    "start_proxy_server",
+]

@@ -1,0 +1,77 @@
+"""Process-wide byte-bounded LRU cache for dataset chunks.
+
+Capability parity with ref bioengine/datasets/chunk_cache.py:18-103
+(1 GB default via env var, asyncio-lock guarded, runtime resize,
+module-level shared instance).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from collections import OrderedDict
+from typing import Optional
+
+DEFAULT_CACHE_SIZE = int(
+    os.environ.get(
+        "BIOENGINE_DATASETS_ZARR_STORE_CACHE_SIZE", str(1024 * 1024 * 1024)
+    )
+)
+
+
+class ChunkCache:
+    """Byte-bounded LRU mapping cache-key -> bytes."""
+
+    def __init__(self, max_bytes: int = DEFAULT_CACHE_SIZE):
+        self.max_bytes = max_bytes
+        self._data: OrderedDict[str, bytes] = OrderedDict()
+        self._size = 0
+        self._lock = asyncio.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    @property
+    def size_bytes(self) -> int:
+        return self._size
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    async def get(self, key: str) -> Optional[bytes]:
+        async with self._lock:
+            value = self._data.get(key)
+            if value is None:
+                self.misses += 1
+                return None
+            self._data.move_to_end(key)
+            self.hits += 1
+            return value
+
+    async def put(self, key: str, value: bytes) -> None:
+        if len(value) > self.max_bytes:
+            return  # never cache an item bigger than the whole budget
+        async with self._lock:
+            old = self._data.pop(key, None)
+            if old is not None:
+                self._size -= len(old)
+            self._data[key] = value
+            self._size += len(value)
+            while self._size > self.max_bytes and self._data:
+                _, evicted = self._data.popitem(last=False)
+                self._size -= len(evicted)
+
+    async def resize(self, max_bytes: int) -> None:
+        async with self._lock:
+            self.max_bytes = max_bytes
+            while self._size > self.max_bytes and self._data:
+                _, evicted = self._data.popitem(last=False)
+                self._size -= len(evicted)
+
+    async def clear(self) -> None:
+        async with self._lock:
+            self._data.clear()
+            self._size = 0
+
+
+# shared across every store in the process (ref chunk_cache.py:103)
+default_cache = ChunkCache()

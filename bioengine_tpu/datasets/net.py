@@ -1,0 +1,52 @@
+"""HTTP GET with bounded retry + exponential backoff.
+
+Capability parity with ref bioengine/datasets/utils/network.py:8-73
+(4 attempts, 0.2 s exponential backoff, 4xx-except-429 never retried).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+import httpx
+
+MAX_ATTEMPTS = 4
+BACKOFF_SECONDS = 0.2
+
+
+async def get_url_with_retry(
+    url: str,
+    params: Optional[dict] = None,
+    headers: Optional[dict] = None,
+    client: Optional[httpx.AsyncClient] = None,
+    max_attempts: int = MAX_ATTEMPTS,
+) -> httpx.Response:
+    owns = client is None
+    if owns:
+        client = httpx.AsyncClient(timeout=httpx.Timeout(60.0))
+    try:
+        last_error: Exception = RuntimeError("unreachable")
+        for attempt in range(max_attempts):
+            try:
+                resp = await client.get(url, params=params, headers=headers)
+                if resp.status_code < 400:
+                    return resp
+                # client errors are permanent, except throttling
+                if 400 <= resp.status_code < 500 and resp.status_code != 429:
+                    resp.raise_for_status()
+                last_error = httpx.HTTPStatusError(
+                    f"HTTP {resp.status_code} for {url}",
+                    request=resp.request,
+                    response=resp,
+                )
+            except httpx.HTTPStatusError:
+                raise
+            except httpx.HTTPError as e:
+                last_error = e
+            if attempt < max_attempts - 1:
+                await asyncio.sleep(BACKOFF_SECONDS * (2**attempt))
+        raise last_error
+    finally:
+        if owns:
+            await client.aclose()
