@@ -27,7 +27,7 @@ from bioengine_tpu.apps.proxy import AppServiceProxy
 from bioengine_tpu.rpc.server import RpcServer
 from bioengine_tpu.serving.controller import DeploymentHandle, ServeController
 from bioengine_tpu.utils.logger import create_logger
-from bioengine_tpu.utils.permissions import check_permissions
+from bioengine_tpu.utils.permissions import check_permissions, create_context
 
 _ADJECTIVES = (
     "amber", "brisk", "calm", "deft", "eager", "fuzzy", "gold", "hazy",
@@ -380,10 +380,10 @@ class AppsManager:
     ) -> list[dict]:
         """Deploy the configured startup apps with admin context
         (ref manager.py:937-1001)."""
-        admin_ctx = {
-            "user": {"id": self.admin_users[0] if self.admin_users else "system"},
-            "ws": "bioengine",
-        }
+        admin_ctx = create_context(
+            self.admin_users[0] if self.admin_users else "system",
+            workspace="bioengine",
+        )
         results = []
         for app_config in startup_applications:
             try:

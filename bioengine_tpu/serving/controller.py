@@ -209,7 +209,7 @@ class ServeController:
         healthy = [
             r
             for r in app.replicas.get(deployment, [])
-            if r.state == ReplicaState.HEALTHY
+            if r.state in (ReplicaState.HEALTHY, ReplicaState.TESTING)
         ]
         if not healthy:
             raise RuntimeError(
@@ -272,7 +272,13 @@ class ServeController:
         if not spec.autoscale:
             return
         replicas = app.replicas.get(spec.name, [])
-        healthy = [r for r in replicas if r.state == ReplicaState.HEALTHY]
+        # TESTING replicas carry real traffic (they are routable), so
+        # they must count toward the load/scaling signal
+        healthy = [
+            r
+            for r in replicas
+            if r.state in (ReplicaState.HEALTHY, ReplicaState.TESTING)
+        ]
         if not healthy:
             return
         avg_load = sum(r.load for r in healthy) / len(healthy)
@@ -334,6 +340,6 @@ class ServeController:
             r.load
             for replicas in app.replicas.values()
             for r in replicas
-            if r.state == ReplicaState.HEALTHY
+            if r.state in (ReplicaState.HEALTHY, ReplicaState.TESTING)
         ]
         return sum(loads) / len(loads) if loads else 0.0

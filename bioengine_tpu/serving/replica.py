@@ -148,7 +148,10 @@ class Replica:
         HTTP traffic so Ray Serve's autoscaler could see WebRTC load,
         ref apps/proxy_deployment.py:405-442 — here the controller reads
         ``load`` directly)."""
-        if self.state != ReplicaState.HEALTHY:
+        # TESTING is routable: init completed, the one-shot background
+        # test is still running — same window in which the reference's
+        # Serve replicas already accept handle calls (ref builder.py:739-811)
+        if self.state not in (ReplicaState.HEALTHY, ReplicaState.TESTING):
             raise RuntimeError(
                 f"replica {self.replica_id} not healthy ({self.state})"
             )

@@ -1,0 +1,248 @@
+"""Admin-only remote Python execution with captured output.
+
+Capability parity with ref bioengine/worker/code_executor.py:19-517:
+source mode (exec + function extraction) and pickle mode (cloudpickle
+payload), per-call resource/env options, timeout, stdout/stderr captured
+AND streamed live through caller-provided callbacks, exception tracebacks
+returned not raised. Where the reference ships the function to a fresh
+Ray worker process, we ship it to a fresh local subprocess on the slice
+host — same isolation boundary (a crash or leaked global can't poison
+the worker), no Ray.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+import cloudpickle
+
+from bioengine_tpu.utils.logger import create_logger
+from bioengine_tpu.utils.permissions import check_permissions
+
+DEFAULT_TIMEOUT_SECONDS = 180.0
+
+# Child-process runner: reads a cloudpickled payload from stdin, resolves
+# the target function (source extraction happens HERE so user top-level
+# code never executes in the worker process), runs it (async-aware), and
+# writes a cloudpickled outcome to the path in argv[1]. stdout/stderr flow
+# through the pipes untouched so the parent can stream them live.
+_RUNNER = r"""
+import asyncio, sys, traceback
+import cloudpickle
+
+
+def _extract_function(code, function_name):
+    # the named function, else ``main``, else the single/last top-level
+    # def (ref code_executor.py:206-260)
+    namespace = {"__name__": "__bioengine_exec__"}
+    exec(compile(code, "<run_code>", "exec"), namespace)
+    functions = {
+        k: v
+        for k, v in namespace.items()
+        if callable(v)
+        and getattr(v, "__module__", None) == "__bioengine_exec__"
+    }
+    if function_name:
+        if function_name not in functions:
+            raise ValueError(
+                f"Function '{function_name}' not found in source "
+                f"(defined: {sorted(functions)})"
+            )
+        return functions[function_name]
+    if "main" in functions:
+        return functions["main"]
+    if len(functions) == 1:
+        return next(iter(functions.values()))
+    if functions:
+        return list(functions.values())[-1]
+    raise ValueError("Source defines no function to execute")
+
+
+result_path = sys.argv[1]
+outcome = {"result": None, "error": None}
+try:
+    payload = cloudpickle.load(sys.stdin.buffer)
+    if payload["mode"] == "source":
+        func = _extract_function(payload["code"], payload["function_name"])
+    else:
+        func = cloudpickle.loads(payload["function"])
+    value = func(*payload["args"], **payload["kwargs"])
+    if asyncio.iscoroutine(value):
+        value = asyncio.run(value)
+    outcome["result"] = value
+except BaseException:
+    outcome["error"] = traceback.format_exc()
+sys.stdout.flush()
+sys.stderr.flush()
+with open(result_path, "wb") as f:
+    cloudpickle.dump(outcome, f)
+"""
+
+
+class CodeExecutor:
+    """Run admin-supplied code in an isolated subprocess."""
+
+    def __init__(
+        self,
+        admin_users: Optional[list[str]] = None,
+        default_timeout: float = DEFAULT_TIMEOUT_SECONDS,
+        log_file: Optional[str] = None,
+        on_submit: Optional[Callable[[], None]] = None,
+    ):
+        self.admin_users = list(admin_users or [])
+        self.default_timeout = default_timeout
+        self.logger = create_logger("code_executor", log_file=log_file)
+        # hook the worker uses to nudge the provisioner after a submit,
+        # mirroring the reference's SLURM autoscale nudge (:490-494)
+        self.on_submit = on_submit
+
+    async def run_code(
+        self,
+        code: Optional[str] = None,
+        function: Optional[bytes | str] = None,
+        mode: str = "source",
+        function_name: Optional[str] = None,
+        args: Optional[list] = None,
+        kwargs: Optional[dict] = None,
+        remote_options: Optional[dict] = None,
+        timeout: Optional[float] = None,
+        write_stdout: Optional[Callable[[str], Any]] = None,
+        write_stderr: Optional[Callable[[str], Any]] = None,
+        context: Optional[dict] = None,
+    ) -> dict:
+        """Execute code and return
+        ``{status, result, error, stdout, stderr, duration_s}``."""
+        check_permissions(context, self.admin_users, "run_code")
+        if mode == "source":
+            if not code:
+                raise ValueError("mode='source' requires `code`")
+            spec: dict[str, Any] = {
+                "mode": "source",
+                "code": code,
+                "function_name": function_name,
+            }
+        elif mode == "pickle":
+            if function is None:
+                raise ValueError("mode='pickle' requires `function`")
+            raw = (
+                base64.b64decode(function)
+                if isinstance(function, str)
+                else function
+            )
+            spec = {"mode": "pickle", "function": raw}
+        else:
+            raise ValueError(f"mode must be 'source' or 'pickle', got '{mode}'")
+        spec["args"] = list(args or [])
+        spec["kwargs"] = dict(kwargs or {})
+        payload = cloudpickle.dumps(spec)
+        options = dict(remote_options or {})
+        env = {**os.environ, **(options.get("env_vars") or {})}
+        started = time.time()
+
+        with tempfile.TemporaryDirectory() as tmp:
+            result_path = Path(tmp) / "outcome.pkl"
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable,
+                "-u",
+                "-c",
+                _RUNNER,
+                str(result_path),
+                stdin=asyncio.subprocess.PIPE,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.PIPE,
+                env=env,
+                cwd=options.get("cwd"),
+            )
+            if self.on_submit:
+                try:
+                    self.on_submit()
+                except Exception:
+                    pass
+
+            stdout_chunks: list[str] = []
+            stderr_chunks: list[str] = []
+
+            async def _pump(stream, chunks, callback):
+                # chunked reads, not readline — a single huge line (e.g. a
+                # large array repr) must not blow the stream buffer limit
+                while True:
+                    data = await stream.read(65536)
+                    if not data:
+                        return
+                    text = data.decode(errors="replace")
+                    chunks.append(text)
+                    if callback:
+                        out = callback(text)
+                        if asyncio.iscoroutine(out):
+                            await out
+
+            async def _drive() -> int:
+                assert proc.stdin is not None
+                proc.stdin.write(payload)
+                await proc.stdin.drain()
+                proc.stdin.close()
+                await asyncio.gather(
+                    _pump(proc.stdout, stdout_chunks, write_stdout),
+                    _pump(proc.stderr, stderr_chunks, write_stderr),
+                )
+                return await proc.wait()
+
+            try:
+                returncode = await asyncio.wait_for(
+                    _drive(), timeout or self.default_timeout
+                )
+            except asyncio.TimeoutError:
+                proc.kill()
+                await proc.wait()
+                return {
+                    "status": "timeout",
+                    "result": None,
+                    "error": (
+                        f"Execution exceeded "
+                        f"{timeout or self.default_timeout:.0f}s timeout"
+                    ),
+                    "stdout": "".join(stdout_chunks),
+                    "stderr": "".join(stderr_chunks),
+                    "duration_s": time.time() - started,
+                }
+            except Exception as e:
+                # never leak the child on a pump/drive failure
+                proc.kill()
+                await proc.wait()
+                return {
+                    "status": "error",
+                    "result": None,
+                    "error": f"Execution driver failed: {e}",
+                    "stdout": "".join(stdout_chunks),
+                    "stderr": "".join(stderr_chunks),
+                    "duration_s": time.time() - started,
+                }
+
+            outcome: dict[str, Any] = {"result": None, "error": None}
+            if result_path.exists():
+                with result_path.open("rb") as f:
+                    outcome = cloudpickle.load(f)
+            elif returncode != 0:
+                outcome["error"] = (
+                    f"Subprocess exited with code {returncode} "
+                    "before reporting a result"
+                )
+
+        return {
+            "status": "error" if outcome["error"] else "ok",
+            "result": outcome["result"],
+            "error": outcome["error"],
+            "stdout": "".join(stdout_chunks),
+            "stderr": "".join(stderr_chunks),
+            "duration_s": time.time() - started,
+        }
+
+    def service_methods(self) -> dict[str, Any]:
+        return {"run_code": self.run_code}

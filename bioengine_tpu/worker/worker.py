@@ -1,0 +1,357 @@
+"""BioEngineWorker — the central lifecycle orchestrator.
+
+Capability parity with ref bioengine/worker/worker.py:142-1217: init the
+component managers, bring up the control plane, register the worker
+service surface, deploy startup applications, run the monitoring loop
+(connection checks, scaling, app auto-redeploy, data-server rediscovery,
+consecutive-error trip wire), aggregate status, tail component logs, and
+shut everything down gracefully in reverse order.
+
+Topology differences by design: the reference connects OUT to an external
+Hypha server and babysits an external Ray cluster; here the control plane
+(RpcServer) and the serving substrate (ServeController over the JAX
+topology) are part of the framework, so "standalone" mode is fully
+self-contained, and ``server_url`` optionally federates this worker's
+service surface onto a remote control plane as well.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from pathlib import Path
+from typing import Any, Optional
+
+from bioengine_tpu.apps.artifacts import LocalArtifactStore
+from bioengine_tpu.apps.builder import AppBuilder
+from bioengine_tpu.apps.manager import AppsManager
+from bioengine_tpu.cluster.cluster import TpuCluster
+from bioengine_tpu.datasets.datasets import BioEngineDatasets
+from bioengine_tpu.datasets.proxy_server import DatasetsServer, rpc_token_validator
+from bioengine_tpu.rpc.client import ServerConnection, connect_to_server
+from bioengine_tpu.rpc.server import RpcServer
+from bioengine_tpu.serving.controller import ServeController
+from bioengine_tpu.utils.logger import LOG_FILE_REGISTRY, create_logger, read_log_tail
+from bioengine_tpu.utils.permissions import check_permissions, create_context
+from bioengine_tpu.worker.code_executor import CodeExecutor
+
+MAX_CONSECUTIVE_MONITOR_ERRORS = 5
+
+
+class BioEngineWorker:
+    def __init__(
+        self,
+        mode: str = "single-machine",
+        workspace_dir: str | Path = "~/.bioengine",
+        admin_users: Optional[list[str]] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        server_url: Optional[str] = None,
+        server_token: Optional[str] = None,
+        datasets_dir: Optional[str | Path] = None,
+        startup_applications: Optional[list[dict]] = None,
+        monitoring_interval_seconds: float = 10.0,
+        provisioner_config: Optional[dict] = None,
+        log_file: Optional[str] = "off",
+        cluster: Optional[TpuCluster] = None,
+    ):
+        self.workspace_dir = Path(workspace_dir).expanduser()
+        self.admin_users = list(admin_users or ["admin"])
+        self.monitoring_interval_seconds = monitoring_interval_seconds
+        self.startup_applications = list(startup_applications or [])
+        self.server_url = server_url
+        self.server_token = server_token
+        self.datasets_dir = Path(datasets_dir).expanduser() if datasets_dir else None
+        self.log_file = log_file
+        if log_file is None:
+            log_file = str(self.workspace_dir / "logs" / "worker.log")
+            self.log_file = log_file
+        self.logger = create_logger("worker", log_file=self.log_file)
+
+        # component managers (ref worker.py:142-357)
+        self.cluster = cluster or TpuCluster(
+            mode=mode,
+            workspace_dir=self.workspace_dir,
+            provisioner_config=provisioner_config,
+            log_file=self.log_file,
+        )
+        self.server = RpcServer(host=host, port=port, admin_users=self.admin_users)
+        self.controller: Optional[ServeController] = None
+        self.apps_manager: Optional[AppsManager] = None
+        self.code_executor = CodeExecutor(
+            admin_users=self.admin_users,
+            log_file=self.log_file,
+            on_submit=self._nudge_scaling,
+        )
+        self.datasets_server: Optional[DatasetsServer] = None
+        self.datasets_client: Optional[BioEngineDatasets] = None
+        self.remote_connection: Optional[ServerConnection] = None
+
+        self.is_ready = False
+        self.start_time: Optional[float] = None
+        self._monitor_task: Optional[asyncio.Task] = None
+        self._monitor_errors = 0
+        self._tripped = False
+        self._stop_event = asyncio.Event()
+        self._service_id: Optional[str] = None
+
+    # ---- lifecycle ----------------------------------------------------------
+
+    async def start(self, blocking: bool = False) -> dict:
+        """Bring the worker up (ref worker.py:925-1001). Returns the
+        service endpoints."""
+        self.start_time = time.time()
+        self.cluster.start()
+        await self.server.start()
+
+        self.controller = ServeController(
+            cluster_state=self.cluster.state, log_file=self.log_file
+        )
+        await self.controller.start()
+
+        artifact_store = LocalArtifactStore(self.workspace_dir / "artifacts")
+        builder = AppBuilder(
+            store=artifact_store,
+            workdir_root=self.workspace_dir / "apps",
+            data_client_factory=self._make_datasets_client,
+            admin_users=self.admin_users,
+        )
+        self.apps_manager = AppsManager(
+            controller=self.controller,
+            server=self.server,
+            store=artifact_store,
+            builder=builder,
+            admin_users=self.admin_users,
+            can_scale_out=self.cluster.mode in ("slurm", "gke"),
+            log_file=self.log_file,
+        )
+
+        # datasets plane: serve locally when a data dir is configured,
+        # otherwise discover an already-running server (ref :451-498)
+        if self.datasets_dir is not None:
+            self.datasets_server = DatasetsServer(
+                self.datasets_dir,
+                token_validator=rpc_token_validator(self.server),
+                log_file=self.log_file,
+            )
+            await self.datasets_server.start()
+        self.datasets_client = self._make_datasets_client()
+
+        self._register_worker_service()
+        if self.server_url:
+            await self._connect_remote()
+
+        if self.startup_applications:
+            await self.apps_manager.deploy_startup_applications(
+                self.startup_applications
+            )
+
+        self._monitor_task = asyncio.create_task(self._monitor_loop())
+        self.is_ready = True
+        self.logger.info(
+            f"worker ready: rpc={self.server.url} "
+            f"datasets={self.datasets_server.url if self.datasets_server else 'external'}"
+        )
+        if blocking:
+            await self._stop_event.wait()
+        return {
+            "rpc_url": self.server.url,
+            "datasets_url": self.datasets_server.url if self.datasets_server else None,
+            "service_id": self._service_id,
+        }
+
+    async def stop(self, context: Optional[dict] = None) -> None:
+        """Graceful shutdown in reverse order (ref worker.py:697-778)."""
+        if context is not None:
+            check_permissions(context, self.admin_users, "stop_worker")
+        self.is_ready = False
+        try:
+            if self._monitor_task:
+                self._monitor_task.cancel()
+                self._monitor_task = None
+            if self.apps_manager:
+                try:
+                    admin_ctx = create_context(
+                        self.admin_users[0], workspace="bioengine"
+                    )
+                    await self.apps_manager.stop_all_apps(context=admin_ctx)
+                except Exception as e:
+                    self.logger.warning(f"stopping apps failed: {e}")
+            if self.controller:
+                await self.controller.stop()
+            if self.remote_connection:
+                await self.remote_connection.disconnect()
+                self.remote_connection = None
+            if self.datasets_client:
+                await self.datasets_client.aclose()
+            if self.datasets_server:
+                await self.datasets_server.stop()
+            await self.server.stop()
+            self.cluster.stop()
+        finally:
+            # always release a blocking start() — a failed teardown must
+            # not leave the process unkillable
+            self._stop_event.set()
+        self.logger.info("worker stopped")
+
+    async def _stop_worker_service(self, context: Optional[dict] = None) -> dict:
+        """RPC-exposed stop: respond first, then shut down — tearing the
+        server down inline would close the caller's socket before the
+        result frame is sent and hang the client forever."""
+        check_permissions(context, self.admin_users, "stop_worker")
+
+        async def _deferred():
+            await asyncio.sleep(0.2)  # let the RESULT frame flush
+            await self.stop()
+
+        asyncio.create_task(_deferred())
+        return {"status": "stopping"}
+
+    def _make_datasets_client(self) -> BioEngineDatasets:
+        url = self.datasets_server.url if self.datasets_server else None
+        return BioEngineDatasets(server_url=url, log_file="off")
+
+    def _nudge_scaling(self) -> None:
+        """Prod the provisioner right after a code submit, mirroring the
+        reference's SLURM autoscale nudge (ref code_executor.py:490-494)."""
+        try:
+            if self.cluster.is_ready:
+                self.cluster.monitor_cluster()
+        except Exception:
+            pass
+
+    # ---- service surface (ref worker.py:614-664) ----------------------------
+
+    def _service_definition(self) -> dict[str, Any]:
+        definition: dict[str, Any] = {
+            "id": "bioengine-worker",
+            "name": "BioEngine worker",
+            "type": "bioengine-worker",
+            "description": "TPU-native BioEngine worker",
+            "config": {"require_context": True, "visibility": "public"},
+            "get_status": self.get_status,
+            "get_logs": self.get_logs,
+            "stop_worker": self._stop_worker_service,
+            **self.code_executor.service_methods(),
+        }
+        assert self.apps_manager is not None
+        definition.update(self.apps_manager.service_methods())
+        return definition
+
+    def _register_worker_service(self) -> None:
+        entry = self.server.register_local_service(self._service_definition())
+        self._service_id = entry.full_id
+
+    async def _connect_remote(self) -> None:
+        """Federate this worker's service surface onto a remote control
+        plane (the reference's Hypha registration, ref worker.py:522-664)."""
+        self.remote_connection = await connect_to_server(
+            {"server_url": self.server_url, "token": self.server_token}
+        )
+        await self.remote_connection.register_service(self._service_definition())
+        self.logger.info(f"registered on remote control plane {self.server_url}")
+
+    # ---- monitoring loop (ref worker.py:780-883) ----------------------------
+
+    async def _monitor_loop(self) -> None:
+        while True:
+            try:
+                await asyncio.sleep(self.monitoring_interval_seconds)
+                await self._monitor_once()
+                self._monitor_errors = 0
+                if self._tripped:
+                    # recovery after the trip wire: monitoring is clean
+                    # again, so readiness is restored
+                    self._tripped = False
+                    self.is_ready = True
+                    self.logger.info("monitoring recovered; worker ready again")
+            except asyncio.CancelledError:
+                return
+            except Exception as e:
+                self._monitor_errors += 1
+                self.logger.error(
+                    f"monitor error ({self._monitor_errors}/"
+                    f"{MAX_CONSECUTIVE_MONITOR_ERRORS}): {e}"
+                )
+                if self._monitor_errors >= MAX_CONSECUTIVE_MONITOR_ERRORS:
+                    self.is_ready = False
+                    self._tripped = True
+                    self.logger.critical(
+                        "worker tripped not-ready after repeated monitor errors"
+                    )
+
+    async def _monitor_once(self) -> None:
+        # cluster: liveness + scaling tick
+        if not self.cluster.check_connection():
+            raise RuntimeError("cluster connection lost")
+        self.cluster.monitor_cluster()
+        # remote control plane: ping, reconnect + re-register on failure
+        if self.server_url:
+            healthy = False
+            if self.remote_connection and self.remote_connection.connected:
+                try:
+                    await self.remote_connection.ping()
+                    healthy = True
+                except Exception:
+                    healthy = False
+            if not healthy:
+                self.logger.warning("remote control plane lost; reconnecting")
+                if self.remote_connection:
+                    await self.remote_connection.disconnect()
+                await self._connect_remote()
+        # datasets: ping, rediscover on failure (ref worker.py:428-498)
+        if self.datasets_client and self.datasets_client.available:
+            if not await self.datasets_client.ping():
+                self.logger.warning("data server unreachable; rediscovering")
+                await self.datasets_client.aclose()
+                self.datasets_client = self._make_datasets_client()
+        # apps: health-driven registration + auto-redeploy
+        if self.apps_manager:
+            await self.apps_manager.monitor_applications()
+
+    # ---- status / logs (ref worker.py:1034-1159) ----------------------------
+
+    def get_status(self, context: Optional[dict] = None) -> dict:
+        uptime = time.time() - self.start_time if self.start_time else 0.0
+        apps = {}
+        if self.apps_manager:
+            try:
+                apps = self.apps_manager.get_app_status()
+            except Exception as e:
+                apps = {"error": str(e)}
+        return {
+            "worker": {
+                "ready": self.is_ready,
+                "start_time": self.start_time,
+                "uptime_seconds": uptime,
+                "rpc_url": self.server.url,
+                "service_id": self._service_id,
+                "admin_users": self.admin_users,
+                "monitor_errors": self._monitor_errors,
+            },
+            "cluster": self.cluster.status,
+            "applications": apps,
+            "datasets": {
+                "server_url": (
+                    self.datasets_server.url
+                    if self.datasets_server
+                    else (self.datasets_client.server_url or None)
+                    if self.datasets_client
+                    else None
+                ),
+                "served_locally": self.datasets_server is not None,
+            },
+        }
+
+    def get_logs(
+        self,
+        component: Optional[str] = None,
+        max_lines: int = 200,
+        context: Optional[dict] = None,
+    ) -> dict:
+        check_permissions(context, self.admin_users, "get_logs")
+        if component is not None:
+            return {component: read_log_tail(component, max_lines)}
+        return {
+            name: read_log_tail(name, max_lines) for name in LOG_FILE_REGISTRY
+        }

@@ -1,0 +1,299 @@
+"""End-to-end worker lifecycle + code executor tests.
+
+Mirrors the reference's e2e tier (ref tests/end_to_end/test_worker.py,
+test_code_executor.py) but hermetic: in-process control plane, local
+artifact paths, no external servers.
+"""
+
+import asyncio
+import base64
+
+import cloudpickle
+import pytest
+
+from bioengine_tpu.utils.permissions import create_context
+from bioengine_tpu.worker.code_executor import CodeExecutor
+from bioengine_tpu.worker.worker import BioEngineWorker
+
+pytestmark = [pytest.mark.end_to_end, pytest.mark.anyio]
+
+ADMIN_CTX = create_context("admin", workspace="bioengine")
+ANON_CTX = create_context("anonymous")
+
+REPO_APPS = __import__("pathlib").Path(__file__).resolve().parent.parent / "apps"
+
+
+# ---- code executor ----------------------------------------------------------
+
+
+@pytest.fixture()
+def executor():
+    return CodeExecutor(admin_users=["admin"], default_timeout=60.0)
+
+
+async def test_run_code_source_mode(executor):
+    result = await executor.run_code(
+        code="def main(x, y):\n    print('working')\n    return x + y\n",
+        args=[2, 3],
+        context=ADMIN_CTX,
+    )
+    assert result["status"] == "ok"
+    assert result["result"] == 5
+    assert "working" in result["stdout"]
+
+
+async def test_run_code_named_function_and_async(executor):
+    code = (
+        "import asyncio\n"
+        "async def compute(n):\n"
+        "    await asyncio.sleep(0)\n"
+        "    return n * 2\n"
+        "def other():\n    return 'no'\n"
+    )
+    result = await executor.run_code(
+        code=code, function_name="compute", args=[21], context=ADMIN_CTX
+    )
+    assert result["result"] == 42
+
+
+async def test_run_code_pickle_mode(executor):
+    def work(a, b=1):
+        return {"sum": a + b}
+
+    payload = base64.b64encode(cloudpickle.dumps(work)).decode()
+    result = await executor.run_code(
+        function=payload, mode="pickle", args=[4], kwargs={"b": 6},
+        context=ADMIN_CTX,
+    )
+    assert result["result"] == {"sum": 10}
+
+
+async def test_run_code_error_propagation(executor):
+    result = await executor.run_code(
+        code="def main():\n    raise ValueError('boom')\n", context=ADMIN_CTX
+    )
+    assert result["status"] == "error"
+    assert "ValueError: boom" in result["error"]
+    assert result["result"] is None
+
+
+async def test_run_code_timeout(executor):
+    result = await executor.run_code(
+        code="import time\ndef main():\n    time.sleep(30)\n",
+        timeout=1.0,
+        context=ADMIN_CTX,
+    )
+    assert result["status"] == "timeout"
+
+
+async def test_run_code_stream_callbacks(executor):
+    lines: list[str] = []
+    result = await executor.run_code(
+        code=(
+            "import sys\n"
+            "def main():\n"
+            "    print('out1')\n"
+            "    print('err1', file=sys.stderr)\n"
+            "    print('out2')\n"
+        ),
+        write_stdout=lines.append,
+        write_stderr=lines.append,
+        context=ADMIN_CTX,
+    )
+    assert result["status"] == "ok"
+    joined = "".join(lines)
+    assert "out1" in joined and "err1" in joined and "out2" in joined
+
+
+async def test_run_code_env_vars(executor):
+    result = await executor.run_code(
+        code="import os\ndef main():\n    return os.environ['MY_FLAG']\n",
+        remote_options={"env_vars": {"MY_FLAG": "on"}},
+        context=ADMIN_CTX,
+    )
+    assert result["result"] == "on"
+
+
+async def test_run_code_requires_admin(executor):
+    with pytest.raises(PermissionError):
+        await executor.run_code(code="def main():\n    return 1\n", context=ANON_CTX)
+
+
+# ---- worker __main__ arg parsing --------------------------------------------
+
+
+def test_worker_arg_parsing():
+    from bioengine_tpu.worker.__main__ import (
+        create_parser,
+        worker_kwargs_from_args,
+    )
+
+    args = create_parser().parse_args(
+        [
+            "--mode", "single-machine",
+            "--admin-users", "alice", "bob",
+            "--startup-applications", '[{"local_path": "apps/demo-app"}]',
+            "--port", "1234",
+        ]
+    )
+    kwargs = worker_kwargs_from_args(args)
+    assert kwargs["admin_users"] == ["alice", "bob"]
+    assert kwargs["startup_applications"] == [{"local_path": "apps/demo-app"}]
+    assert kwargs["port"] == 1234
+
+
+def test_worker_startup_app_json_validation():
+    from bioengine_tpu.worker.__main__ import parse_startup_applications
+
+    assert parse_startup_applications(None) == []
+    assert parse_startup_applications('{"a": 1}') == [{"a": 1}]
+    with pytest.raises(ValueError):
+        parse_startup_applications('["not-a-dict"]')
+
+
+# ---- full worker lifecycle --------------------------------------------------
+
+
+@pytest.fixture()
+async def worker(tmp_path):
+    w = BioEngineWorker(
+        mode="single-machine",
+        workspace_dir=tmp_path / "ws",
+        admin_users=["admin"],
+        startup_applications=[{"local_path": str(REPO_APPS / "demo-app")}],
+        monitoring_interval_seconds=0.2,
+        log_file="off",
+    )
+    await w.start()
+    try:
+        yield w
+    finally:
+        if w.is_ready:
+            await w.stop()
+
+
+async def test_worker_status_shape(worker):
+    status = worker.get_status(context=ADMIN_CTX)
+    assert status["worker"]["ready"] is True
+    assert status["worker"]["uptime_seconds"] >= 0
+    assert status["cluster"]["ready"] is True
+    assert status["cluster"]["topology"]["n_chips"] == 8
+    assert len(status["applications"]) == 1
+    (app_status,) = status["applications"].values()
+    assert app_status["status"] == "RUNNING"
+    assert app_status["name"] == "Demo App"
+    assert "ping" in app_status["available_methods"]
+
+
+async def test_worker_service_call_through_rpc(worker):
+    """Call the startup app through the registered RPC service surface."""
+    (app_id,) = worker.apps_manager.records
+    result = await worker.server.call_service_method(
+        f"bioengine/{app_id}", "echo", kwargs={"message": "hi"}
+    )
+    assert result["echo"] == "hi"
+
+
+async def test_worker_run_code_service(worker):
+    result = await worker.server.call_service_method(
+        "bioengine/bioengine-worker",
+        "run_code",
+        kwargs={"code": "def main():\n    return 7\n"},
+        caller=worker.server._tokens[worker.server.issue_token("admin")],
+    )
+    assert result["result"] == 7
+
+
+async def test_worker_monitoring_recovers_and_counts_errors(worker):
+    await asyncio.sleep(0.5)  # a few monitor ticks
+    assert worker._monitor_errors == 0
+    assert worker.is_ready
+
+
+async def test_worker_deploy_and_stop_app(worker, tmp_path):
+    result = await worker.apps_manager.deploy_app(
+        local_path=str(REPO_APPS / "demo-app"),
+        deployment_kwargs={"demo_deployment": {"greeting": "Yo"}},
+        context=ADMIN_CTX,
+    )
+    app_id = result["app_id"]
+    echo = await worker.server.call_service_method(
+        f"bioengine/{app_id}", "echo", kwargs={"message": "x"}
+    )
+    assert echo["greeting"] == "Yo"
+    await worker.apps_manager.stop_app(app_id, context=ADMIN_CTX)
+    assert app_id not in worker.apps_manager.records
+
+
+async def test_worker_get_logs_requires_admin(worker):
+    with pytest.raises(PermissionError):
+        worker.get_logs(context=ANON_CTX)
+    logs = worker.get_logs(context=ADMIN_CTX)
+    assert isinstance(logs, dict)
+
+
+async def test_run_code_huge_output_line(executor):
+    result = await executor.run_code(
+        code="def main():\n    print('x' * 200000)\n    return 1\n",
+        context=ADMIN_CTX,
+    )
+    assert result["status"] == "ok"
+    assert result["result"] == 1
+    assert len(result["stdout"]) >= 200000
+
+
+async def test_run_code_toplevel_exit_is_contained(executor):
+    """Top-level code (incl. sys.exit) runs in the subprocess, never in
+    the worker process."""
+    result = await executor.run_code(
+        code="import sys\nsys.exit(3)\ndef main():\n    return 1\n",
+        context=ADMIN_CTX,
+    )
+    assert result["status"] == "error"
+    assert "SystemExit" in result["error"]
+
+
+async def test_stop_worker_over_websocket(tmp_path):
+    """A remote stop_worker call must get its response before teardown."""
+    from bioengine_tpu.rpc.client import connect_to_server
+
+    w = BioEngineWorker(
+        mode="single-machine",
+        workspace_dir=tmp_path / "ws3",
+        admin_users=["admin"],
+        monitoring_interval_seconds=5.0,
+        log_file="off",
+    )
+    await w.start()
+    token = w.server.issue_token("admin")
+    conn = await connect_to_server({"server_url": w.server.url, "token": token})
+    svc = await conn.get_service("bioengine-worker")
+    result = await asyncio.wait_for(svc.stop_worker(), timeout=10.0)
+    assert result["status"] == "stopping"
+    await conn.disconnect()
+    await asyncio.wait_for(w._stop_event.wait(), timeout=10.0)
+    assert not w.is_ready
+
+
+async def test_worker_graceful_stop(tmp_path):
+    w = BioEngineWorker(
+        mode="single-machine",
+        workspace_dir=tmp_path / "ws2",
+        admin_users=["admin"],
+        monitoring_interval_seconds=5.0,
+        log_file="off",
+    )
+    await w.start()
+    assert w.is_ready
+    await w.stop()
+    assert not w.is_ready
+    # lock released: a second worker can start in the same workspace
+    w2 = BioEngineWorker(
+        mode="single-machine",
+        workspace_dir=tmp_path / "ws2",
+        admin_users=["admin"],
+        log_file="off",
+    )
+    await w2.start()
+    assert w2.is_ready
+    await w2.stop()
