@@ -126,6 +126,29 @@ class LocalArtifactStore:
         marker.write_text(version)
         return artifact_id, version
 
+    def put_files(
+        self,
+        files: dict[str, bytes | str],
+        artifact_id: Optional[str] = None,
+        version: Optional[str] = None,
+    ) -> tuple[str, str]:
+        """Upload from an in-memory {relative_path: content} mapping —
+        the wire form used by remote CLI uploads, where the client's
+        filesystem is not visible to the worker."""
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            base = Path(tmp)
+            for rel, content in files.items():
+                target = (base / rel).resolve()
+                if not str(target).startswith(str(base.resolve())):
+                    raise ValueError(f"path traversal in upload: '{rel}'")
+                target.parent.mkdir(parents=True, exist_ok=True)
+                if isinstance(content, str):
+                    content = content.encode()
+                target.write_bytes(content)
+            return self.put(base, artifact_id, version)
+
     def delete(self, artifact_id: str, version: Optional[str] = None) -> None:
         adir = self.root / artifact_id
         if not adir.exists():

@@ -264,15 +264,24 @@ class AppsManager:
 
     def upload_app(
         self,
-        src_dir: str,
+        src_dir: Optional[str] = None,
+        files: Optional[dict] = None,
         artifact_id: Optional[str] = None,
         version: Optional[str] = None,
         context: Optional[dict] = None,
     ) -> dict:
+        """Upload from a worker-local directory OR an in-memory file
+        mapping (what remote CLI clients send — their filesystem is not
+        visible here)."""
         check_permissions(context, self.admin_users, "upload_app")
         if self.store is None:
             raise RuntimeError("no artifact store configured")
-        aid, ver = self.store.put(src_dir, artifact_id, version)
+        if (src_dir is None) == (files is None):
+            raise ValueError("provide exactly one of src_dir or files")
+        if files is not None:
+            aid, ver = self.store.put_files(files, artifact_id, version)
+        else:
+            aid, ver = self.store.put(src_dir, artifact_id, version)
         return {"artifact_id": aid, "version": ver}
 
     def get_app_manifest(

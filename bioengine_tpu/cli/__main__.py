@@ -1,0 +1,3 @@
+from bioengine_tpu.cli.cli import main
+
+main()

@@ -1,0 +1,37 @@
+"""``bioengine cluster`` — cluster state from the shell.
+
+Capability parity with ref bioengine/cli/cluster.py:48-131 (human/JSON
+view of the worker's cluster status).
+"""
+
+from __future__ import annotations
+
+import click
+
+from bioengine_tpu.cli.utils import emit, run_async, server_options, with_worker
+
+
+@click.group("cluster")
+def cluster_group() -> None:
+    """Inspect the worker's compute substrate."""
+
+
+@cluster_group.command("status")
+@server_options
+def status_command(server_url, token):
+    """Topology, worker processes, and utilization snapshot."""
+
+    async def action(worker):
+        status = await worker.get_status()
+        return status["cluster"]
+
+    cluster = run_async(with_worker(server_url, token, action))
+    topo = cluster.get("topology") or {}
+    lines = [
+        f"mode:   {cluster.get('mode')}",
+        f"ready:  {cluster.get('ready')}",
+        f"chips:  {topo.get('n_chips')} x {topo.get('platform')} "
+        f"across {topo.get('n_hosts')} host(s)",
+        f"workers: {len(cluster.get('workers', []))}",
+    ]
+    emit(cluster, human="\n".join(lines))

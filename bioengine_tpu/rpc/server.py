@@ -114,8 +114,11 @@ class RpcServer:
         workspace: Optional[str] = None,
         ttl_seconds: Optional[float] = None,
         is_admin: Optional[bool] = None,
+        token_value: Optional[str] = None,
     ) -> str:
-        token = secrets.token_urlsafe(32)
+        # token_value lets the worker honor a pre-shared admin token
+        # (env BIOENGINE_ADMIN_TOKEN) instead of a generated one
+        token = token_value or secrets.token_urlsafe(32)
         self._tokens[token] = TokenInfo(
             user_id=user_id,
             workspace=workspace or self.default_workspace,

@@ -50,7 +50,8 @@ def create_logger(
     logger.handlers.clear()
 
     datefmt = "%Y-%m-%d %H:%M:%S %z"
-    stream = logging.StreamHandler(sys.stdout)
+    # stderr, not stdout: CLI/service data output must stay parseable
+    stream = logging.StreamHandler(sys.stderr)
     stream.setFormatter(
         _ColorFormatter(
             "%(asctime)s - %(name)s - %(levelcolor)s - %(message)s", datefmt=datefmt

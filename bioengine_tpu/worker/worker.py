@@ -18,6 +18,7 @@ service surface onto a remote control plane as well.
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 from pathlib import Path
 from typing import Any, Optional
@@ -137,6 +138,7 @@ class BioEngineWorker:
             await self.datasets_server.start()
         self.datasets_client = self._make_datasets_client()
 
+        self._write_admin_token()
         self._register_worker_service()
         if self.server_url:
             await self._connect_remote()
@@ -206,6 +208,23 @@ class BioEngineWorker:
 
         asyncio.create_task(_deferred())
         return {"status": "stopping"}
+
+    def _write_admin_token(self) -> None:
+        """Bootstrap operator auth: issue a long-lived admin token and
+        drop it (0600) into the workspace so the CLI on this machine can
+        authenticate — the analog of the reference's admin-token
+        validation via Hypha login (ref worker.py:522-612). A pre-shared
+        token can be forced via env BIOENGINE_ADMIN_TOKEN."""
+        token = self.server.issue_token(
+            self.admin_users[0],
+            ttl_seconds=30 * 86400,
+            is_admin=True,
+            token_value=os.environ.get("BIOENGINE_ADMIN_TOKEN"),
+        )
+        self.admin_token = token
+        path = self.workspace_dir / "admin_token"
+        path.write_text(token)
+        path.chmod(0o600)
 
     def _make_datasets_client(self) -> BioEngineDatasets:
         url = self.datasets_server.url if self.datasets_server else None

@@ -1,0 +1,233 @@
+"""CLI tests: click commands driven against a live in-process worker.
+
+Mirrors the reference's CLI surface (ref bioengine/cli/) but hermetic —
+the worker runs in a background thread with its own event loop, the CLI
+connects over the real WebSocket control plane.
+"""
+
+import asyncio
+import json
+import threading
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+from bioengine_tpu.cli.cli import main as cli_main
+from bioengine_tpu.cli.utils import coerce_value, parse_kv_args, read_image, write_image
+
+pytestmark = [pytest.mark.end_to_end]
+
+REPO_APPS = __import__("pathlib").Path(__file__).resolve().parent.parent / "apps"
+
+
+# ---- pure helpers -----------------------------------------------------------
+
+
+def test_coerce_value():
+    assert coerce_value("3") == 3
+    assert coerce_value("3.5") == 3.5
+    assert coerce_value("true") is True
+    assert coerce_value('{"a": 1}') == {"a": 1}
+    assert coerce_value("[1,2]") == [1, 2]
+    assert coerce_value("plain text") == "plain text"
+
+
+def test_parse_kv_args():
+    import click
+
+    out = parse_kv_args(("x=1", "name=bob", 'cfg={"k": 2}'))
+    assert out == {"x": 1, "name": "bob", "cfg": {"k": 2}}
+    with pytest.raises(click.UsageError):
+        parse_kv_args(("novalue",))
+
+
+def test_image_roundtrip(tmp_path):
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    write_image(tmp_path / "a.npy", arr)
+    np.testing.assert_array_equal(read_image(tmp_path / "a.npy"), arr)
+    write_image(tmp_path / "a.npz", arr)
+    np.testing.assert_array_equal(read_image(tmp_path / "a.npz"), arr)
+    img = (np.random.default_rng(0).random((5, 5)) * 255).astype(np.uint8)
+    write_image(tmp_path / "a.png", img)
+    np.testing.assert_array_equal(read_image(tmp_path / "a.png"), img)
+
+
+# ---- live worker fixture ----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def live_worker(tmp_path_factory):
+    """A worker running in a daemon thread with its own loop."""
+    from bioengine_tpu.worker.worker import BioEngineWorker
+
+    tmp = tmp_path_factory.mktemp("cli-worker")
+    holder: dict = {}
+    started = threading.Event()
+
+    def _run():
+        async def _main():
+            worker = BioEngineWorker(
+                mode="single-machine",
+                workspace_dir=tmp / "ws",
+                admin_users=["admin"],
+                startup_applications=[
+                    {"local_path": str(REPO_APPS / "demo-app")}
+                ],
+                monitoring_interval_seconds=5.0,
+                log_file="off",
+            )
+            await worker.start()
+            holder["worker"] = worker
+            holder["url"] = worker.server.url
+            holder["token"] = worker.server.issue_token("admin")
+            holder["loop"] = asyncio.get_running_loop()
+            started.set()
+            await worker._stop_event.wait()
+
+        asyncio.run(_main())
+
+    thread = threading.Thread(target=_run, daemon=True)
+    thread.start()
+    assert started.wait(timeout=60), "worker failed to start"
+    yield holder
+    asyncio.run_coroutine_threadsafe(
+        holder["worker"].stop(), holder["loop"]
+    ).result(timeout=30)
+    thread.join(timeout=10)
+
+
+def _cli(live_worker, *args):
+    runner = CliRunner()
+    return runner.invoke(
+        cli_main,
+        list(args)
+        + ["--server-url", live_worker["url"], "--token", live_worker["token"]],
+        catch_exceptions=False,
+    )
+
+
+# ---- commands ---------------------------------------------------------------
+
+
+def test_cli_status(live_worker):
+    result = _cli(live_worker, "status")
+    assert result.exit_code == 0, result.stdout
+    payload = json.loads(result.stdout)
+    assert payload["worker"]["ready"] is True
+
+
+def test_cli_cluster_status(live_worker):
+    result = _cli(live_worker, "cluster", "status")
+    assert result.exit_code == 0, result.stdout
+    payload = json.loads(result.stdout)
+    assert payload["topology"]["n_chips"] == 8
+
+
+def test_cli_call_list_methods(live_worker):
+    (app_id,) = live_worker["worker"].apps_manager.records
+    result = _cli(live_worker, "call", app_id, "--list-methods")
+    assert result.exit_code == 0, result.stdout
+    payload = json.loads(result.stdout)
+    assert "echo" in payload["methods"]
+
+
+def test_cli_call_method_with_args(live_worker):
+    (app_id,) = live_worker["worker"].apps_manager.records
+    result = _cli(live_worker, "call", app_id, "echo", "--arg", "message=hello")
+    assert result.exit_code == 0, result.stdout
+    payload = json.loads(result.stdout)
+    assert payload["echo"] == "hello"
+
+
+def test_cli_call_args_json(live_worker):
+    (app_id,) = live_worker["worker"].apps_manager.records
+    result = _cli(
+        live_worker, "call", app_id, "echo", "--args", '{"message": "via-json"}'
+    )
+    assert result.exit_code == 0, result.stdout
+    assert json.loads(result.stdout)["echo"] == "via-json"
+
+
+def test_cli_apps_upload_list_run_stop(live_worker):
+    result = _cli(
+        live_worker, "apps", "upload", str(REPO_APPS / "demo-app")
+    )
+    assert result.exit_code == 0, result.stdout
+    uploaded = json.loads(result.stdout)
+    assert uploaded["artifact_id"] == "demo-app"
+
+    result = _cli(live_worker, "apps", "list")
+    assert result.exit_code == 0
+    assert any(a["artifact_id"] == "demo-app" for a in json.loads(result.stdout))
+
+    result = _cli(
+        live_worker, "apps", "run", "--artifact-id", "demo-app",
+        "--deployment-kwargs", '{"demo_deployment": {"greeting": "CLI"}}',
+    )
+    assert result.exit_code == 0, result.stdout
+    app_id = json.loads(result.stdout)["app_id"]
+
+    result = _cli(live_worker, "apps", "status", app_id)
+    assert result.exit_code == 0
+    assert json.loads(result.stdout)["status"] in ("RUNNING", "DEPLOYING")
+
+    result = _cli(live_worker, "call", app_id, "echo", "--arg", "message=x")
+    assert json.loads(result.stdout)["greeting"] == "CLI"
+
+    result = _cli(live_worker, "apps", "logs", app_id)
+    assert result.exit_code == 0
+
+    result = _cli(live_worker, "apps", "stop", app_id)
+    assert result.exit_code == 0
+    assert json.loads(result.stdout)["status"] == "STOPPED"
+
+
+def test_cli_upload_sends_file_contents(live_worker, tmp_path):
+    """Uploads must work from a directory the WORKER cannot see — file
+    contents travel over RPC."""
+    import shutil
+
+    src = tmp_path / "client-only-app"
+    shutil.copytree(REPO_APPS / "demo-app", src)
+    manifest = (src / "manifest.yaml").read_text().replace(
+        "id: demo-app", "id: client-app"
+    )
+    (src / "manifest.yaml").write_text(manifest)
+    result = _cli(live_worker, "apps", "upload", str(src))
+    assert result.exit_code == 0, result.stdout
+    assert json.loads(result.stdout)["artifact_id"] == "client-app"
+    # the worker stored it in ITS artifact store
+    assert "client-app" in live_worker["worker"].apps_manager.store.list_artifacts()
+
+
+def test_cli_run_local_path_and_raw_env(live_worker, tmp_path):
+    result = _cli(
+        live_worker, "apps", "run",
+        "--local-path", str(REPO_APPS / "demo-app"),
+        "--env", "FLAG=true",
+    )
+    assert result.exit_code == 0, result.stdout
+    app_id = json.loads(result.stdout)["app_id"]
+    # env value must arrive as the literal string "true", not Python True
+    result = _cli(live_worker, "call", app_id, "get_env", "--arg", "key=FLAG")
+    assert json.loads(result.stdout)["value"] == "true"
+    _cli(live_worker, "apps", "stop", app_id)
+
+
+def test_cli_bad_json_is_usage_error(live_worker):
+    runner = CliRunner()
+    result = runner.invoke(
+        cli_main,
+        ["call", "any", "m", "--args", "{bad", "--server-url", live_worker["url"]],
+    )
+    assert result.exit_code == 2  # click usage error, not a traceback
+    assert "not valid JSON" in result.stderr
+
+
+def test_cli_missing_server_url(monkeypatch):
+    monkeypatch.delenv("BIOENGINE_SERVER_URL", raising=False)
+    runner = CliRunner()
+    result = runner.invoke(cli_main, ["status"])
+    assert result.exit_code != 0
+    assert "server" in (result.stderr + str(result)).lower()
