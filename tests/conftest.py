@@ -53,3 +53,41 @@ def tmp_workspace(tmp_path):
 def anyio_backend():
     # async tests run via the anyio pytest plugin on plain asyncio
     return "asyncio"
+
+
+REPO_APPS = Path(__file__).resolve().parent.parent / "apps"
+
+
+@pytest.fixture
+async def stack(tmp_path):
+    """controller + rpc server + apps manager wired together in-process,
+    sharing one artifact store — the hermetic analog of the reference's
+    real-cluster session fixture (ref tests/conftest.py:136-161)."""
+    from bioengine_tpu.apps.artifacts import LocalArtifactStore
+    from bioengine_tpu.apps.builder import AppBuilder
+    from bioengine_tpu.apps.manager import AppsManager
+    from bioengine_tpu.cluster.state import ClusterState
+    from bioengine_tpu.rpc.server import RpcServer
+    from bioengine_tpu.serving.controller import ServeController
+
+    server = RpcServer(admin_users=["admin"])
+    await server.start()
+    controller = ServeController(ClusterState(), health_check_period=3600)
+    store = LocalArtifactStore(tmp_path / "store")
+    builder = AppBuilder(
+        store=store,
+        workdir_root=tmp_path / "workdirs",
+        admin_users=["admin"],
+        log_file="off",
+    )
+    manager = AppsManager(
+        controller=controller,
+        server=server,
+        store=store,
+        builder=builder,
+        admin_users=["admin"],
+        log_file="off",
+    )
+    yield manager, controller, server, store
+    await controller.stop()
+    await server.stop()

@@ -6,12 +6,8 @@ import yaml
 
 from bioengine_tpu.apps.artifacts import ArtifactVersionError, LocalArtifactStore
 from bioengine_tpu.apps.builder import AppBuildError, AppBuilder
-from bioengine_tpu.apps.manager import AppsManager
 from bioengine_tpu.apps.manifest import ManifestError, load_manifest, validate_manifest
 from bioengine_tpu.apps.proxy import check_method_permission
-from bioengine_tpu.cluster.state import ClusterState
-from bioengine_tpu.rpc.server import RpcServer
-from bioengine_tpu.serving.controller import ServeController
 from bioengine_tpu.utils.permissions import create_context
 
 pytestmark = [pytest.mark.integration, pytest.mark.anyio]
@@ -233,30 +229,6 @@ class TestMethodAcl:
     def test_no_entry_denies(self):
         with pytest.raises(PermissionError):
             check_method_permission({"x": ["a"]}, "infer", create_context("a"))
-
-
-@pytest.fixture
-async def stack(tmp_path):
-    """controller + rpc server + manager wired together (in-process)."""
-    server = RpcServer(admin_users=["admin"])
-    await server.start()
-    controller = ServeController(ClusterState(), health_check_period=3600)
-    store = LocalArtifactStore(tmp_path / "store")
-    builder = AppBuilder(
-        store=store, workdir_root=tmp_path / "workdirs",
-        admin_users=["admin"], log_file="off",
-    )
-    manager = AppsManager(
-        controller=controller,
-        server=server,
-        store=store,
-        builder=builder,
-        admin_users=["admin"],
-        log_file="off",
-    )
-    yield manager, controller, server, store
-    await controller.stop()
-    await server.stop()
 
 
 ADMIN = create_context("admin")

@@ -1,0 +1,50 @@
+"""End-to-end tests for the bundled applications (ref tests/apps/ — the
+reference tests its apps against live deployments; here the same flows
+run against the in-process controller + RPC server stack)."""
+
+import asyncio
+from pathlib import Path
+
+import pytest
+
+from bioengine_tpu.utils.permissions import create_context
+
+pytestmark = [pytest.mark.integration, pytest.mark.anyio]
+
+REPO_APPS = Path(__file__).resolve().parent.parent / "apps"
+ADMIN = create_context("admin")
+
+
+async def deploy(manager, app_dir, **kwargs):
+    result = await manager.deploy_app(
+        local_path=str(REPO_APPS / app_dir), context=ADMIN, **kwargs
+    )
+    await asyncio.sleep(0.05)
+    return result
+
+
+async def call(server, service_id, method, **kwargs):
+    caller = server.validate_token(server.issue_token("user"))
+    return await server.call_service_method(
+        service_id, method, kwargs=kwargs, caller=caller
+    )
+
+
+class TestTpuTest:
+    async def test_ping_and_device_probe(self, stack):
+        manager, _, server, _ = stack
+        result = await deploy(manager, "tpu-test")
+        sid = result["service_id"]
+
+        out = await call(server, sid, "ping")
+        assert out["status"] == "ok"
+
+        info = await call(server, sid, "tpu_info")
+        assert info["error"] == ""
+        # hermetic suite runs on the 8-virtual-device CPU backend
+        assert info["backend"] == "cpu"
+        assert info["device_count"] == 8
+        assert info["matmul_norm"] == pytest.approx(128.0 * 128.0, rel=1e-2)
+
+        mem = await call(server, sid, "memory_info")
+        assert len(mem["devices"]) == 8
