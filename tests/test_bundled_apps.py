@@ -3,8 +3,12 @@ reference tests its apps against live deployments; here the same flows
 run against the in-process controller + RPC server stack)."""
 
 import asyncio
+import io
+import os
+import time
 from pathlib import Path
 
+import numpy as np
 import pytest
 
 from bioengine_tpu.utils.permissions import create_context
@@ -28,6 +32,337 @@ async def call(server, service_id, method, **kwargs):
     return await server.call_service_method(
         service_id, method, kwargs=kwargs, caller=caller
     )
+
+
+# ---- model-runner -----------------------------------------------------------
+
+
+def _flatten(tree, prefix=""):
+    out = {}
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            out.update(_flatten(v, f"{prefix}{k}/"))
+        else:
+            out[f"{prefix}{k}"] = np.asarray(v)
+    return out
+
+
+@pytest.fixture(scope="module")
+def model_collection(tmp_path_factory):
+    """A local bioimage.io-style collection: a jax_params UNet, a
+    pytorch_state_dict model, and one that failed inference checks."""
+    import jax
+    import jax.numpy as jnp
+    import yaml
+
+    from bioengine_tpu.models.unet import UNet2D
+
+    root = tmp_path_factory.mktemp("collection")
+
+    # tiny-unet: TPU-native jax_params weights
+    d = root / "tiny-unet"
+    d.mkdir()
+    model = UNet2D(features=(8, 16), out_channels=1)
+    x = np.random.default_rng(0).normal(size=(1, 64, 64, 1)).astype(np.float32)
+    params = model.init(jax.random.key(0), jnp.asarray(x))["params"]
+    # jit to match the inference engine's compiled program bit-for-bit
+    # (bf16 rounding differs between eager and fused execution)
+    expected = np.asarray(
+        jax.jit(lambda p, a: model.apply({"params": p}, a))(params, jnp.asarray(x))
+    )
+    np.savez(d / "weights.npz", **_flatten(params))
+    np.save(d / "test_input.npy", x)
+    np.save(d / "test_output.npy", expected)
+    (d / "rdf.yaml").write_text(
+        yaml.safe_dump(
+            {
+                "type": "model",
+                "name": "Tiny UNet",
+                "description": "tiny segmentation test model",
+                "tags": ["segmentation", "nuclei"],
+                "inputs": [{"name": "input0", "axes": "byxc"}],
+                "outputs": [{"name": "output0", "axes": "byxc"}],
+                "test_inputs": ["test_input.npy"],
+                "test_outputs": ["test_output.npy"],
+                "documentation": "README.md",
+                "weights": {
+                    "jax_params": {
+                        "source": "weights.npz",
+                        "architecture": {
+                            "name": "unet2d",
+                            "kwargs": {"features": [8, 16], "out_channels": 1},
+                        },
+                    }
+                },
+            }
+        )
+    )
+    (d / "README.md").write_text("# Tiny UNet\ntest model docs")
+
+    # torch-square: pytorch_state_dict via architecture source exec
+    import torch
+
+    d2 = root / "torch-square"
+    d2.mkdir()
+    (d2 / "arch.py").write_text(
+        "import torch\n"
+        "class SquareNet(torch.nn.Module):\n"
+        "    def __init__(self, scale=1.0):\n"
+        "        super().__init__()\n"
+        "        self.scale = torch.nn.Parameter(torch.tensor(float(scale)))\n"
+        "    def forward(self, x):\n"
+        "        return x * x * self.scale\n"
+    )
+    ns: dict = {}
+    exec((d2 / "arch.py").read_text(), ns)
+    module = ns["SquareNet"](scale=2.0)
+    torch.save(module.state_dict(), d2 / "weights.pt")
+    x2 = np.random.default_rng(1).normal(size=(1, 32, 32, 1)).astype(np.float32)
+    np.save(d2 / "test_input.npy", x2)
+    np.save(d2 / "test_output.npy", (x2 * x2 * 2.0).astype(np.float32))
+    (d2 / "rdf.yaml").write_text(
+        yaml.safe_dump(
+            {
+                "type": "model",
+                "name": "Torch Square",
+                "description": "elementwise square model",
+                "inputs": [{"name": "input0", "axes": "byxc"}],
+                "outputs": [{"name": "output0", "axes": "byxc"}],
+                "test_inputs": ["test_input.npy"],
+                "test_outputs": ["test_output.npy"],
+                "weights": {
+                    "pytorch_state_dict": {
+                        "source": "weights.pt",
+                        "architecture": {
+                            "callable": "SquareNet",
+                            "source": "arch.py",
+                            "kwargs": {"scale": 2.0},
+                        },
+                    }
+                },
+            }
+        )
+    )
+
+    # failed-check model (exists but did not pass inference checks)
+    d3 = root / "secret-model"
+    d3.mkdir()
+    (d3 / "rdf.yaml").write_text(
+        yaml.safe_dump(
+            {
+                "type": "model",
+                "name": "Secret",
+                "description": "did not pass checks",
+                "inputs": [{"name": "input0", "axes": "byxc"}],
+                "outputs": [{"name": "output0", "axes": "byxc"}],
+                "weights": {"jax_params": {"source": "missing.npz"}},
+            }
+        )
+    )
+
+    (root / "collection.yaml").write_text(
+        yaml.safe_dump(
+            {
+                "bioengine_inference": {
+                    "tiny-unet": {"status": "passed"},
+                    "torch-square": {"status": "passed"},
+                    "secret-model": {"status": "failed"},
+                }
+            }
+        )
+    )
+    return root
+
+
+@pytest.fixture
+async def model_runner(stack, model_collection, tmp_path, monkeypatch):
+    monkeypatch.setenv("BIOENGINE_LOCAL_MODEL_PATH", str(model_collection))
+    manager, _, server, _ = stack
+    result = await deploy(
+        manager,
+        "model-runner",
+        deployment_kwargs={
+            "entry_deployment": {"cache_dir": str(tmp_path / "model-cache")}
+        },
+    )
+    return result, server
+
+
+class TestModelRunner:
+    async def test_search_models(self, model_runner):
+        result, server = model_runner
+        sid = result["service_id"]
+        out = await call(server, sid, "search_models")
+        ids = {m["model_id"] for m in out}
+        assert ids == {"tiny-unet", "torch-square"}  # checks filter applied
+
+        out = await call(server, sid, "search_models", keywords=["nuclei"])
+        assert [m["model_id"] for m in out] == ["tiny-unet"]
+
+        out = await call(server, sid, "search_models", ignore_checks=True)
+        assert {m["model_id"] for m in out} == {
+            "tiny-unet", "torch-square", "secret-model",
+        }
+
+    async def test_rdf_and_documentation(self, model_runner):
+        result, server = model_runner
+        sid = result["service_id"]
+        rdf = await call(server, sid, "get_model_rdf", model_id="tiny-unet")
+        assert rdf["name"] == "Tiny UNet"
+        doc = await call(
+            server, sid, "get_model_documentation", model_id="tiny-unet"
+        )
+        assert "Tiny UNet" in doc
+        none_doc = await call(
+            server, sid, "get_model_documentation", model_id="torch-square"
+        )
+        assert none_doc is None
+
+    async def test_validate(self, model_runner):
+        result, server = model_runner
+        sid = result["service_id"]
+        good = await call(
+            server, sid, "validate",
+            rdf_dict={
+                "name": "m", "type": "model",
+                "inputs": [{"axes": "byxc"}], "outputs": [{"axes": "byxc"}],
+                "weights": {"jax_params": {"source": "w.npz"}},
+            },
+        )
+        assert good["success"]
+        bad = await call(server, sid, "validate", rdf_dict={"name": "m"})
+        assert not bad["success"]
+        assert "inputs" in bad["details"]
+
+    async def test_model_test_and_report_cache(self, model_runner, tmp_path):
+        result, server = model_runner
+        sid = result["service_id"]
+        report = await call(server, sid, "test", model_id="tiny-unet")
+        assert report["status"] == "passed"
+        assert report["backend"] == "xla"
+        assert report["output_matches_expected"] is True
+        cache_file = (
+            tmp_path / "model-cache" / "tiny-unet" / ".test_cache.json"
+        )
+        assert cache_file.exists()
+        again = await call(server, sid, "test", model_id="tiny-unet")
+        assert again == report
+
+    async def test_infer_jax_model(self, model_runner, model_collection):
+        result, server = model_runner
+        sid = result["service_id"]
+        x = np.load(model_collection / "tiny-unet" / "test_input.npy")
+        expected = np.load(model_collection / "tiny-unet" / "test_output.npy")
+        out = await call(server, sid, "infer", model_id="tiny-unet", inputs=x)
+        assert out["_meta"]["backend"] == "xla"
+        np.testing.assert_allclose(out["output0"], expected, rtol=1e-4, atol=1e-4)
+
+    async def test_infer_torch_fallback(self, model_runner):
+        result, server = model_runner
+        sid = result["service_id"]
+        x = np.full((1, 32, 32, 1), 3.0, np.float32)
+        out = await call(server, sid, "infer", model_id="torch-square", inputs=x)
+        assert out["_meta"]["backend"] == "torch"
+        np.testing.assert_allclose(out["output0"], np.full_like(x, 18.0), rtol=1e-5)
+
+    async def test_unpublished_model_rejected(self, model_runner):
+        result, server = model_runner
+        sid = result["service_id"]
+        with pytest.raises(Exception, match="inference check"):
+            await call(
+                server, sid, "infer",
+                model_id="secret-model",
+                inputs=np.zeros((1, 32, 32, 1), np.float32),
+            )
+
+    async def test_upload_roundtrip(self, model_runner):
+        result, server = model_runner
+        sid = result["service_id"]
+        slot = await call(server, sid, "get_upload_url", file_type=".npy")
+        x = np.full((1, 32, 32, 1), 2.0, np.float32)
+        buf = io.BytesIO()
+        np.save(buf, x)
+        await call(
+            server, sid, "upload_image",
+            file_path=slot["file_path"], data=buf.getvalue(),
+        )
+        out = await call(
+            server, sid, "infer",
+            model_id="torch-square", inputs=slot["file_path"],
+        )
+        np.testing.assert_allclose(out["output0"], np.full_like(x, 8.0), rtol=1e-5)
+
+    async def test_upload_traversal_rejected(self, model_runner):
+        result, server = model_runner
+        sid = result["service_id"]
+        for evil in ("../../etc/shadow", "../uploads-evil/x.npy"):
+            with pytest.raises(Exception, match="escapes"):
+                await call(
+                    server, sid, "upload_image", file_path=evil, data=b"x"
+                )
+
+    async def test_list_cached_models(self, model_runner):
+        result, server = model_runner
+        sid = result["service_id"]
+        await call(
+            server, sid, "infer",
+            model_id="tiny-unet",
+            inputs=np.zeros((1, 64, 64, 1), np.float32),
+        )
+        cached = await call(server, sid, "list_cached_models")
+        assert any(m["model_id"] == "tiny-unet" for m in cached)
+
+
+class TestModelCacheProtocol:
+    """ModelCache unit-level behavior (ref entry_deployment.py:73-1009)."""
+
+    def _load_entry_module(self):
+        import importlib.util
+
+        path = REPO_APPS / "model-runner" / "entry_deployment.py"
+        spec = importlib.util.spec_from_file_location("mr_entry", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    async def test_lru_eviction_respects_in_use(
+        self, model_collection, tmp_path
+    ):
+        mod = self._load_entry_module()
+        source = mod.LocalCollectionSource(model_collection)
+        cache = mod.ModelCache(
+            tmp_path / "cache", source, max_size_bytes=1  # force eviction
+        )
+        pkg = await cache.get_model_package("tiny-unet", allow_unpublished=True)
+        async with pkg:
+            # tiny-unet is in use: fetching another model must not evict it
+            await cache.get_model_package("torch-square", allow_unpublished=True)
+            assert pkg.path.exists()
+        # not in use anymore: the next download evicts the LRU package
+        await cache.get_model_package(
+            "torch-square", allow_unpublished=True, skip_cache=True
+        )
+        assert not (tmp_path / "cache" / "tiny-unet").exists()
+
+    async def test_stale_marker_recovery(self, model_collection, tmp_path):
+        mod = self._load_entry_module()
+        source = mod.LocalCollectionSource(model_collection)
+        cache = mod.ModelCache(tmp_path / "cache", source)
+        marker = cache._marker("tiny-unet", False)
+        marker.touch()
+        old = time.time() - mod.STALE_DOWNLOAD_SECONDS - 10
+        os.utime(marker, (old, old))
+        pkg = await cache.get_model_package("tiny-unet", allow_unpublished=True)
+        assert pkg.path.exists()
+        assert not marker.exists()
+
+    async def test_url_as_model_id_rejected(self, model_collection, tmp_path):
+        mod = self._load_entry_module()
+        cache = mod.ModelCache(
+            tmp_path / "cache", mod.LocalCollectionSource(model_collection)
+        )
+        with pytest.raises(ValueError, match="not a model id"):
+            await cache.get_model_package("https://example.com/model")
 
 
 class TestTpuTest:
