@@ -1,0 +1,508 @@
+"""Cellpose fine-tuning on TPU — training sessions, live inference, export.
+
+The reference (ref apps/cellpose-finetuning/main.py, 5211 LoC) fine-tunes
+Cellpose-SAM on exactly one GPU through a re-implemented torch train loop
+with callbacks, a stop-file check, per-epoch snapshots feeding live
+inference, and a ``status.json`` session protocol polled by the browser
+frontend (:1740-1900, :1278-1360, :3682-4966). This TPU rebuild keeps the
+session protocol — session dirs, ``status.json``, STOP file, per-epoch
+snapshots, restart-from-snapshot — and replaces the compute:
+
+- ``CellposeNet`` (bioengine_tpu/models/cellpose.py), a JAX/optax train
+  step jitted **data-parallel over every local chip** via
+  ``jit_data_parallel_step`` — gradients all-reduce over ICI, a
+  capability the reference does not have (SURVEY.md §2.3).
+- Training targets (flow fields) from instance masks via
+  ``ops.flows.masks_to_flows`` on host, once per session.
+- Snapshots are flat-npz ``jax_params`` — the exact weight format the
+  model-runner app serves, so ``export_model`` emits a ready-to-serve
+  BioImage-Model-Zoo-style package.
+"""
+
+import asyncio
+import json
+import shutil
+import time
+import uuid
+from pathlib import Path
+
+import numpy as np
+import yaml
+
+from bioengine_tpu.rpc import schema_method
+
+DEFAULT_CONFIG = {
+    "features": [32, 64, 128, 256],
+    "learning_rate": 1e-4,
+    "weight_decay": 1e-5,
+    "epochs": 10,
+    "batch_size": 8,
+    "tile": 128,
+    "seed": 0,
+}
+
+
+def _now() -> float:
+    return time.time()
+
+
+class TrainingSession:
+    """One fine-tune run: a directory with status.json, snapshots, STOP."""
+
+    def __init__(self, root: Path, session_id: str, config: dict):
+        self.session_id = session_id
+        self.dir = root / session_id
+        self.models_dir = self.dir / "models"
+        self.data_dir = self.dir / "data"
+        self.models_dir.mkdir(parents=True, exist_ok=True)
+        self.data_dir.mkdir(parents=True, exist_ok=True)
+        self.config = config
+        self.task: asyncio.Task | None = None
+
+    # ---- status.json protocol (ref main.py:1740-1900) --------------------
+
+    @property
+    def status_path(self) -> Path:
+        return self.dir / "status.json"
+
+    @property
+    def stop_path(self) -> Path:
+        return self.dir / "STOP"
+
+    def read_status(self) -> dict:
+        try:
+            return json.loads(self.status_path.read_text())
+        except (OSError, json.JSONDecodeError):
+            return {"session_id": self.session_id, "status": "unknown"}
+
+    def write_status(self, **updates) -> dict:
+        status = self.read_status()
+        status.update(updates, session_id=self.session_id, updated_at=_now())
+        tmp = self.status_path.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(status))
+        tmp.rename(self.status_path)
+        return status
+
+    def stop_requested(self) -> bool:
+        return self.stop_path.exists()
+
+    # ---- snapshots -------------------------------------------------------
+
+    def snapshot_path(self, epoch: int) -> Path:
+        return self.models_dir / f"epoch_{epoch:04d}.npz"
+
+    @property
+    def latest_path(self) -> Path:
+        return self.models_dir / "latest.npz"
+
+    def save_snapshot(self, epoch: int, params) -> None:
+        from bioengine_tpu.runtime.convert import save_params_npz
+
+        path = self.snapshot_path(epoch)
+        save_params_npz(str(path), params)
+        tmp = self.latest_path.with_suffix(".npz.tmp")
+        shutil.copyfile(path, tmp)
+        tmp.rename(self.latest_path)  # atomic: live inference never sees a partial file
+
+    def snapshots(self) -> list[str]:
+        return sorted(p.name for p in self.models_dir.glob("epoch_*.npz"))
+
+
+class CellposeFinetune:
+    def __init__(self, sessions_root: str = "~/.bioengine/cellpose-sessions"):
+        self.sessions_root = Path(sessions_root).expanduser()
+        self.sessions_root.mkdir(parents=True, exist_ok=True)
+        self.sessions: dict[str, TrainingSession] = {}
+        self._fwd_cache: dict[tuple, object] = {}  # features -> jitted forward
+        self._recover_sessions()
+
+    def _recover_sessions(self) -> None:
+        """Re-adopt session dirs from a previous replica life (the
+        reference recovers sessions from disk the same way; training
+        tasks do not survive, so running ones become 'interrupted')."""
+        for d in self.sessions_root.iterdir():
+            if (d / "status.json").exists():
+                try:
+                    cfg = json.loads((d / "config.json").read_text())
+                except (OSError, json.JSONDecodeError):
+                    cfg = dict(DEFAULT_CONFIG)
+                s = TrainingSession(self.sessions_root, d.name, cfg)
+                if s.read_status().get("status") == "training":
+                    s.write_status(
+                        status="interrupted",
+                        error="worker restarted during training",
+                    )
+                self.sessions[d.name] = s
+
+    async def check_health(self):
+        if not self.sessions_root.exists():
+            raise RuntimeError("sessions root vanished")
+
+    # ---- data handling ---------------------------------------------------
+
+    @staticmethod
+    def _prepare_images(images: list) -> np.ndarray:
+        """-> (N, H, W, 2) float32, per-image 1-99 percentile normalized.
+        Grayscale gets a zero second channel (cellpose channel
+        convention: [cyto, nucleus])."""
+        out = []
+        for img in images:
+            a = np.asarray(img, np.float32)
+            if a.ndim == 2:
+                a = np.stack([a, np.zeros_like(a)], axis=-1)
+            elif a.ndim == 3 and a.shape[-1] == 1:
+                a = np.concatenate([a, np.zeros_like(a)], axis=-1)
+            elif a.ndim == 3 and a.shape[-1] > 2:
+                a = a[..., :2]
+            lo, hi = np.percentile(a[..., 0], [1, 99])
+            a = (a - lo) / max(hi - lo, 1e-6)
+            out.append(a)
+        return np.stack(out)
+
+    def _prepare_training_data(
+        self, session: TrainingSession, images: list, labels: list
+    ) -> None:
+        """Normalize images, derive flow targets from masks, persist to
+        the session's data dir (restart_training reuses them)."""
+        from bioengine_tpu.ops.flows import masks_to_flows
+
+        x = self._prepare_images(images)
+        masks = np.stack([np.asarray(m) for m in labels]).astype(np.int32)
+        if masks.shape[:3] != x.shape[:3]:
+            raise ValueError(
+                f"images {x.shape[:3]} and labels {masks.shape[:3]} disagree"
+            )
+        flows = np.stack([masks_to_flows(m) for m in masks])  # (N, 2, H, W)
+        flows = np.moveaxis(flows, 1, -1)  # (N, H, W, 2)
+        cellprob = (masks > 0).astype(np.float32)
+        np.savez(
+            session.data_dir / "train.npz",
+            images=x, flows=flows, cellprob=cellprob,
+        )
+
+    # ---- the train loop (runs in a thread) -------------------------------
+
+    def _train_loop(self, session: TrainingSession, resume: bool) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from bioengine_tpu.models.cellpose import (
+            CellposeNet, TrainState, make_train_step,
+        )
+        from bioengine_tpu.parallel.data_parallel import (
+            jit_data_parallel_step, replicate, shard_batch,
+        )
+        from bioengine_tpu.parallel.mesh import make_mesh
+        from bioengine_tpu.runtime.convert import load_params_npz
+
+        cfg = session.config
+        data = np.load(session.data_dir / "train.npz")
+        images, flows, cellprob = data["images"], data["flows"], data["cellprob"]
+        n, H, W = images.shape[:3]
+        tile = min(cfg["tile"], H, W)
+
+        # dp over every local chip that divides the batch
+        n_dev = jax.local_device_count()
+        batch = cfg["batch_size"]
+        dp = 1
+        while dp * 2 <= n_dev and batch % (dp * 2) == 0:
+            dp *= 2
+        mesh = make_mesh({"dp": dp}, jax.devices()[:dp])
+
+        model = CellposeNet(features=tuple(cfg["features"]), in_channels=2)
+        rng = np.random.default_rng(cfg["seed"])
+        start_epoch = 0
+        if resume and session.latest_path.exists():
+            params = load_params_npz(str(session.latest_path))
+            done = session.snapshots()
+            start_epoch = len(done)
+        else:
+            params = model.init(
+                jax.random.key(cfg["seed"]),
+                jnp.zeros((1, tile, tile, 2), jnp.float32),
+            )["params"]
+        tx = optax.adamw(cfg["learning_rate"], weight_decay=cfg["weight_decay"])
+        state = replicate(mesh, TrainState.create(model.apply, params, tx))
+        step = jit_data_parallel_step(make_train_step(), mesh)
+
+        def sample_batch():
+            idx = rng.integers(0, n, size=batch)
+            ys = rng.integers(0, H - tile + 1, size=batch)
+            xs = rng.integers(0, W - tile + 1, size=batch)
+            bi = np.empty((batch, tile, tile, 2), np.float32)
+            bf = np.empty((batch, tile, tile, 2), np.float32)
+            bc = np.empty((batch, tile, tile), np.float32)
+            for j, (i, y0, x0) in enumerate(zip(idx, ys, xs)):
+                sl = np.s_[y0 : y0 + tile, x0 : x0 + tile]
+                im, fl, cp = images[i][sl], flows[i][sl], cellprob[i][sl]
+                if rng.random() < 0.5:  # horizontal flip (flips x-flow sign)
+                    im, cp = im[:, ::-1], cp[:, ::-1]
+                    fl = fl[:, ::-1] * np.array([1.0, -1.0], np.float32)
+                if rng.random() < 0.5:  # vertical flip (flips y-flow sign)
+                    im, cp = im[::-1], cp[::-1]
+                    fl = fl[::-1] * np.array([-1.0, 1.0], np.float32)
+                bi[j], bf[j], bc[j] = im, fl, cp
+            return bi, bf, bc
+
+        steps_per_epoch = max(1, n * max(H // tile, 1) * max(W // tile, 1) // batch)
+        session.write_status(
+            status="training",
+            total_epochs=cfg["epochs"],
+            current_epoch=start_epoch,
+            steps_per_epoch=steps_per_epoch,
+            mesh={"dp": dp},
+        )
+        losses = session.read_status().get("losses", [])
+        for epoch in range(start_epoch, cfg["epochs"]):
+            epoch_losses = []
+            for _ in range(steps_per_epoch):
+                if session.stop_requested():
+                    session.write_status(status="stopped", current_epoch=epoch)
+                    return
+                bi, bf, bc = sample_batch()
+                sharded = shard_batch(
+                    mesh, (jnp.asarray(bi), jnp.asarray(bf), jnp.asarray(bc))
+                )
+                state, metrics = step(state, *sharded)
+                epoch_losses.append(float(metrics["loss"]))
+            mean_loss = float(np.mean(epoch_losses))
+            losses.append(mean_loss)
+            # per-epoch snapshot feeds live inference (ref main.py:1825-1835)
+            session.save_snapshot(epoch, jax.device_get(state.params))
+            session.write_status(
+                status="training",
+                current_epoch=epoch + 1,
+                losses=losses,
+                last_loss=mean_loss,
+            )
+        session.write_status(status="completed", current_epoch=cfg["epochs"])
+
+    async def _run_training(self, session: TrainingSession, resume: bool):
+        try:
+            await asyncio.to_thread(self._train_loop, session, resume)
+        except Exception as e:
+            session.write_status(status="failed", error=str(e))
+
+    # ---- service API ------------------------------------------------------
+
+    @schema_method
+    async def get_default_config(self, context=None):
+        """Training hyperparameters and their defaults."""
+        return dict(DEFAULT_CONFIG)
+
+    @schema_method
+    async def start_training(
+        self,
+        train_images: list,
+        train_labels: list,
+        config: dict | None = None,
+        session_id: str | None = None,
+        context=None,
+    ):
+        """Start a fine-tuning session. ``train_images``: list of (H, W)
+        or (H, W, C) arrays; ``train_labels``: instance-label masks of
+        the same spatial shape. Returns the session id to poll with
+        ``get_training_status``."""
+        cfg = {**DEFAULT_CONFIG, **(config or {})}
+        session_id = session_id or f"session-{uuid.uuid4().hex[:8]}"
+        existing = self.sessions.get(session_id)
+        if existing is not None and (
+            existing.task is None or not existing.task.done()
+        ):
+            # task None = registered by a concurrent start_training that
+            # is still preparing data — treat as training to close the race
+            raise RuntimeError(f"session '{session_id}' already training")
+        # a reused id is a fresh run: stale snapshots/data would poison
+        # restart_training's epoch counting and live inference
+        old_dir = self.sessions_root / session_id
+        if old_dir.exists():
+            shutil.rmtree(old_dir)
+        session = TrainingSession(self.sessions_root, session_id, cfg)
+        self.sessions[session_id] = session  # claim the id before awaiting
+        try:
+            (session.dir / "config.json").write_text(json.dumps(cfg))
+            session.write_status(
+                status="initializing", started_at=_now(), losses=[],
+                n_images=len(train_images),
+            )
+            await asyncio.to_thread(
+                self._prepare_training_data, session, train_images, train_labels
+            )
+        except BaseException:
+            del self.sessions[session_id]
+            raise
+        session.task = asyncio.create_task(self._run_training(session, False))
+        return {"session_id": session_id, "status": "started"}
+
+    @schema_method
+    async def stop_training(self, session_id: str, context=None):
+        """Request a graceful stop (checked per batch, like the
+        reference's stop-file, ref main.py:1278-1360)."""
+        session = self._get_session(session_id)
+        session.stop_path.touch()
+        if session.task:
+            await asyncio.wait([session.task], timeout=30)
+        return session.read_status()
+
+    @schema_method
+    async def restart_training(self, session_id: str, context=None):
+        """Resume a stopped/interrupted/failed session from its latest
+        snapshot (ref main.py:4117)."""
+        session = self._get_session(session_id)
+        if session.task and not session.task.done():
+            raise RuntimeError(f"session '{session_id}' is still running")
+        if not (session.data_dir / "train.npz").exists():
+            raise RuntimeError(
+                f"session '{session_id}' has no persisted training data"
+            )
+        session.stop_path.unlink(missing_ok=True)
+        session.write_status(status="initializing", error=None)
+        session.task = asyncio.create_task(self._run_training(session, True))
+        return {"session_id": session_id, "status": "restarted"}
+
+    @schema_method
+    async def get_training_status(self, session_id: str, context=None):
+        """The session's status.json: state, epoch progress, losses."""
+        return self._get_session(session_id).read_status()
+
+    @schema_method
+    async def list_sessions(self, context=None):
+        """All sessions with their current status and snapshot count."""
+        return [
+            {
+                **s.read_status(),
+                "snapshots": len(s.snapshots()),
+            }
+            for s in self.sessions.values()
+        ]
+
+    @schema_method
+    async def delete_session(self, session_id: str, context=None):
+        """Remove a session directory (must not be training)."""
+        session = self._get_session(session_id)
+        if session.task and not session.task.done():
+            raise RuntimeError(f"stop session '{session_id}' first")
+        shutil.rmtree(session.dir, ignore_errors=True)
+        del self.sessions[session_id]
+        return {"deleted": session_id}
+
+    @schema_method
+    async def infer(
+        self,
+        session_id: str,
+        images: list,
+        cellprob_threshold: float = 0.0,
+        min_size: int = 15,
+        context=None,
+    ):
+        """Segment images with the session's latest snapshot — live
+        inference against a training run works because snapshots are
+        written atomically per epoch."""
+        session = self._get_session(session_id)
+        if not session.latest_path.exists():
+            raise RuntimeError(
+                f"session '{session_id}' has no snapshot yet"
+            )
+        masks = await asyncio.to_thread(
+            self._infer, session, images, cellprob_threshold, min_size
+        )
+        return {
+            "masks": masks,
+            "n_cells": [int(m.max()) for m in masks],
+            "snapshot": session.snapshots()[-1] if session.snapshots() else None,
+        }
+
+    def _infer(self, session, images, cellprob_threshold, min_size):
+        import jax
+
+        from bioengine_tpu.models.cellpose import CellposeNet
+        from bioengine_tpu.ops.flows import predictions_to_masks
+        from bioengine_tpu.runtime.buckets import bucket_shape, crop_to, pad_to
+        from bioengine_tpu.runtime.convert import load_params_npz
+
+        cfg = session.config
+        features = tuple(cfg["features"])
+        model = CellposeNet(features=features, in_channels=2)
+        # one jitted forward per architecture: params are an argument, so
+        # per-epoch snapshots and repeated infer calls reuse the compiled
+        # program instead of retracing a fresh lambda every request
+        if features not in self._fwd_cache:
+            self._fwd_cache[features] = jax.jit(
+                lambda p, a, m=model: m.apply({"params": p}, a)
+            )
+        fwd = self._fwd_cache[features]
+        params = load_params_npz(str(session.latest_path))
+        x = self._prepare_images(images)
+        H, W = x.shape[1:3]
+        bh, bw = bucket_shape((H, W), divisor=model.divisor)
+        pred = np.asarray(fwd(params, pad_to(x, (bh, bw))))
+        pred = crop_to(pred, (H, W))
+        return [
+            predictions_to_masks(
+                p, cellprob_threshold=cellprob_threshold, min_size=min_size
+            )
+            for p in pred
+        ]
+
+    @schema_method
+    async def export_model(
+        self,
+        session_id: str,
+        model_name: str | None = None,
+        context=None,
+    ):
+        """Package the session's latest snapshot as a model-runner-ready
+        ``jax_params`` model directory (rdf.yaml + weights.npz + test
+        tensors) — the TPU analog of the reference's BioImage Model Zoo
+        export (ref main.py:4413+, model_template.py:18)."""
+        session = self._get_session(session_id)
+        if not session.latest_path.exists():
+            raise RuntimeError(f"session '{session_id}' has no snapshot")
+        name = model_name or f"cellpose-{session_id}"
+        export_dir = self.sessions_root / "exports" / name
+        export_dir.mkdir(parents=True, exist_ok=True)
+        shutil.copyfile(session.latest_path, export_dir / "weights.npz")
+        cfg = session.config
+        rdf = {
+            "type": "model",
+            "name": name,
+            "description": (
+                f"Cellpose flow-field model fine-tuned in BioEngine-TPU "
+                f"session {session_id}"
+            ),
+            "tags": ["cellpose", "segmentation", "fine-tuned"],
+            "inputs": [{"name": "input0", "axes": "byxc"}],
+            "outputs": [{"name": "output0", "axes": "byxc"}],
+            "weights": {
+                "jax_params": {
+                    "source": "weights.npz",
+                    "architecture": {
+                        "name": "cellpose",
+                        "kwargs": {
+                            "features": list(cfg["features"]),
+                            "in_channels": 2,
+                        },
+                    },
+                }
+            },
+            "training": {
+                "session_id": session_id,
+                "config": cfg,
+                "final_loss": session.read_status().get("last_loss"),
+            },
+        }
+        (export_dir / "rdf.yaml").write_text(yaml.safe_dump(rdf))
+        return {
+            "model_path": str(export_dir),
+            "name": name,
+            "weights_format": "jax_params",
+        }
+
+    def _get_session(self, session_id: str) -> TrainingSession:
+        if session_id not in self.sessions:
+            raise KeyError(
+                f"unknown session '{session_id}' "
+                f"(have: {sorted(self.sessions)})"
+            )
+        return self.sessions[session_id]

@@ -105,16 +105,11 @@ class Pipeline:
         if self.weights_format == "jax_params":
             from bioengine_tpu.models.registry import get_model
 
+            from bioengine_tpu.runtime.convert import load_params_npz
+
             arch = entry.get("architecture") or {}
             model = get_model(arch.get("name", ""), **(arch.get("kwargs") or {}))
-            loaded = np.load(self._resolve(entry["source"]))
-            params = {}
-            for key in loaded.files:
-                node = params
-                parts = key.split("/")
-                for p in parts[:-1]:
-                    node = node.setdefault(p, {})
-                node[parts[-1]] = loaded[key]
+            params = load_params_npz(str(self._resolve(entry["source"])))
             engine = InferenceEngine(
                 model_id=self._model_key(),
                 apply_fn=lambda prm, x: model.apply({"params": prm}, x),

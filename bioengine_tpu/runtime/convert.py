@@ -120,3 +120,38 @@ def count_params(params: Any) -> int:
     import jax
 
     return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+
+
+# ---- flat npz param serialization (the "jax_params" weight format) ----------
+
+
+def flatten_params(tree: Mapping[str, Any], prefix: str = "") -> dict[str, np.ndarray]:
+    """Nested params dict -> {"a/b/c": array} for npz storage."""
+    out: dict[str, np.ndarray] = {}
+    for k, v in tree.items():
+        if isinstance(v, Mapping):
+            out.update(flatten_params(v, f"{prefix}{k}/"))
+        else:
+            out[f"{prefix}{k}"] = np.asarray(v)
+    return out
+
+
+def unflatten_params(flat: Mapping[str, np.ndarray]) -> dict[str, Any]:
+    """Inverse of ``flatten_params``."""
+    params: dict[str, Any] = {}
+    for key, value in flat.items():
+        node = params
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = np.asarray(value)
+    return params
+
+
+def save_params_npz(path: str, params: Mapping[str, Any]) -> None:
+    np.savez(path, **flatten_params(params))
+
+
+def load_params_npz(path: str) -> dict[str, Any]:
+    with np.load(path) as data:
+        return unflatten_params({k: data[k] for k in data.files})

@@ -37,16 +37,6 @@ async def call(server, service_id, method, **kwargs):
 # ---- model-runner -----------------------------------------------------------
 
 
-def _flatten(tree, prefix=""):
-    out = {}
-    for k, v in tree.items():
-        if isinstance(v, dict):
-            out.update(_flatten(v, f"{prefix}{k}/"))
-        else:
-            out[f"{prefix}{k}"] = np.asarray(v)
-    return out
-
-
 @pytest.fixture(scope="module")
 def model_collection(tmp_path_factory):
     """A local bioimage.io-style collection: a jax_params UNet, a
@@ -70,7 +60,9 @@ def model_collection(tmp_path_factory):
     expected = np.asarray(
         jax.jit(lambda p, a: model.apply({"params": p}, a))(params, jnp.asarray(x))
     )
-    np.savez(d / "weights.npz", **_flatten(params))
+    from bioengine_tpu.runtime.convert import save_params_npz
+
+    save_params_npz(str(d / "weights.npz"), params)
     np.save(d / "test_input.npy", x)
     np.save(d / "test_output.npy", expected)
     (d / "rdf.yaml").write_text(
@@ -363,6 +355,198 @@ class TestModelCacheProtocol:
         )
         with pytest.raises(ValueError, match="not a model id"):
             await cache.get_model_package("https://example.com/model")
+
+
+# ---- cellpose-finetuning ----------------------------------------------------
+
+
+def _synthetic_cells(n=2, size=64, seed=0):
+    """Images with gaussian-blob cells + matching instance masks."""
+    rng = np.random.default_rng(seed)
+    images, masks = [], []
+    yy, xx = np.mgrid[:size, :size]
+    for _ in range(n):
+        img = rng.normal(0.1, 0.02, (size, size)).astype(np.float32)
+        mask = np.zeros((size, size), np.int32)
+        for lbl, (cy, cx) in enumerate(
+            [(16, 16), (16, 48), (48, 16), (48, 48)], start=1
+        ):
+            r2 = (yy - cy) ** 2 + (xx - cx) ** 2
+            disk = r2 < 8**2
+            img[disk] += 1.0
+            mask[disk] = lbl
+        images.append(img)
+        masks.append(mask)
+    return images, masks
+
+
+FAST_CFG = {
+    "features": [8, 16],
+    "epochs": 2,
+    "batch_size": 4,
+    "tile": 32,
+    "learning_rate": 1e-3,
+}
+
+
+@pytest.fixture
+async def cellpose_app(stack, tmp_path):
+    manager, _, server, _ = stack
+    result = await deploy(
+        manager,
+        "cellpose-finetuning",
+        deployment_kwargs={
+            "main": {"sessions_root": str(tmp_path / "sessions")}
+        },
+    )
+    return result, server
+
+
+async def wait_for_status(server, sid, session_id, states, timeout=120):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        status = await call(
+            server, sid, "get_training_status", session_id=session_id
+        )
+        if status["status"] in states:
+            return status
+        await asyncio.sleep(0.2)
+    raise TimeoutError(f"session never reached {states}: {status}")
+
+
+class TestCellposeFinetune:
+    async def test_full_session_lifecycle(self, cellpose_app, tmp_path):
+        result, server = cellpose_app
+        sid = result["service_id"]
+        images, masks = _synthetic_cells()
+
+        started = await call(
+            server, sid, "start_training",
+            train_images=images, train_labels=masks, config=FAST_CFG,
+            session_id="session-test",
+        )
+        assert started["status"] == "started"
+        final = await wait_for_status(
+            server, sid, "session-test", {"completed", "failed"}
+        )
+        assert final["status"] == "completed", final.get("error")
+        assert final["current_epoch"] == 2
+        assert len(final["losses"]) == 2
+        # loss must decrease on this trivially-learnable data
+        assert final["losses"][-1] < final["losses"][0]
+
+        sessions = await call(server, sid, "list_sessions")
+        assert sessions[0]["session_id"] == "session-test"
+        assert sessions[0]["snapshots"] == 2
+
+        out = await call(
+            server, sid, "infer", session_id="session-test", images=images[:1]
+        )
+        assert out["masks"][0].shape == (64, 64)
+        assert out["snapshot"] == "epoch_0001.npz"
+
+        exported = await call(
+            server, sid, "export_model", session_id="session-test"
+        )
+        export_dir = Path(exported["model_path"])
+        assert (export_dir / "rdf.yaml").exists()
+        assert (export_dir / "weights.npz").exists()
+
+        # the export is a servable model-runner package: load it through
+        # the runtime pipeline and predict
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "mr_rt", REPO_APPS / "model-runner" / "runtime_deployment.py"
+        )
+        rt = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(rt)
+        pipeline = rt.Pipeline(export_dir)
+        x = np.stack([np.stack([images[0], np.zeros_like(images[0])], -1)])
+        pred = pipeline.predict(x)["output0"]
+        assert pred.shape == (1, 64, 64, 3)
+
+    async def test_stop_and_restart(self, cellpose_app):
+        result, server = cellpose_app
+        sid = result["service_id"]
+        images, masks = _synthetic_cells()
+        cfg = {**FAST_CFG, "epochs": 50}
+
+        await call(
+            server, sid, "start_training",
+            train_images=images, train_labels=masks, config=cfg,
+            session_id="session-stop",
+        )
+        # let at least one snapshot land, then stop
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            status = await call(
+                server, sid, "get_training_status", session_id="session-stop"
+            )
+            if status.get("current_epoch", 0) >= 1:
+                break
+            await asyncio.sleep(0.2)
+        stopped = await call(server, sid, "stop_training", session_id="session-stop")
+        assert stopped["status"] in ("stopped", "completed")
+
+        restarted = await call(
+            server, sid, "restart_training", session_id="session-stop"
+        )
+        assert restarted["status"] == "restarted"
+        status = await wait_for_status(
+            server, sid, "session-stop",
+            {"training", "completed", "stopped", "failed"},
+        )
+        assert status["status"] != "failed"
+        await call(server, sid, "stop_training", session_id="session-stop")
+
+    async def test_session_id_reuse_starts_fresh(self, cellpose_app):
+        result, server = cellpose_app
+        sid = result["service_id"]
+        images, masks = _synthetic_cells(n=1)
+        await call(
+            server, sid, "start_training",
+            train_images=images, train_labels=masks, config=FAST_CFG,
+            session_id="session-reuse",
+        )
+        await wait_for_status(server, sid, "session-reuse", {"completed"})
+        # reuse the id: stale snapshots from the first run must be gone
+        await call(
+            server, sid, "start_training",
+            train_images=images, train_labels=masks,
+            config={**FAST_CFG, "epochs": 1},
+            session_id="session-reuse",
+        )
+        final = await wait_for_status(
+            server, sid, "session-reuse", {"completed", "failed"}
+        )
+        assert final["status"] == "completed"
+        assert final["current_epoch"] == 1
+        sessions = await call(server, sid, "list_sessions")
+        entry = next(
+            s for s in sessions if s["session_id"] == "session-reuse"
+        )
+        assert entry["snapshots"] == 1
+
+    async def test_unknown_session_rejected(self, cellpose_app):
+        result, server = cellpose_app
+        sid = result["service_id"]
+        with pytest.raises(Exception, match="unknown session"):
+            await call(server, sid, "get_training_status", session_id="nope")
+
+    async def test_delete_session(self, cellpose_app, tmp_path):
+        result, server = cellpose_app
+        sid = result["service_id"]
+        images, masks = _synthetic_cells(n=1)
+        await call(
+            server, sid, "start_training",
+            train_images=images, train_labels=masks, config=FAST_CFG,
+            session_id="session-del",
+        )
+        await wait_for_status(server, sid, "session-del", {"completed", "failed"})
+        out = await call(server, sid, "delete_session", session_id="session-del")
+        assert out == {"deleted": "session-del"}
+        assert not (tmp_path / "sessions" / "session-del").exists()
 
 
 class TestTpuTest:
