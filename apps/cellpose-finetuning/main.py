@@ -107,6 +107,17 @@ class TrainingSession:
     def snapshots(self) -> list[str]:
         return sorted(p.name for p in self.models_dir.glob("epoch_*.npz"))
 
+    @property
+    def train_state_path(self) -> Path:
+        """Full TrainState (params + optimizer moments + step) so resume
+        continues adamw where it left off instead of re-warming."""
+        return self.models_dir / "train_state.msgpack"
+
+    def save_train_state(self, state_bytes: bytes) -> None:
+        tmp = self.train_state_path.with_suffix(".msgpack.tmp")
+        tmp.write_bytes(state_bytes)
+        tmp.rename(self.train_state_path)
+
 
 class CellposeFinetune:
     def __init__(self, sessions_root: str = "~/.bioengine/cellpose-sessions"):
@@ -154,8 +165,11 @@ class CellposeFinetune:
                 a = np.concatenate([a, np.zeros_like(a)], axis=-1)
             elif a.ndim == 3 and a.shape[-1] > 2:
                 a = a[..., :2]
-            lo, hi = np.percentile(a[..., 0], [1, 99])
-            a = (a - lo) / max(hi - lo, 1e-6)
+            # per-channel percentiles — mixed-bit-depth channels (8-bit
+            # cyto + 16-bit nucleus) must each land in [0, 1]
+            for c in range(a.shape[-1]):
+                lo, hi = np.percentile(a[..., c], [1, 99])
+                a[..., c] = (a[..., c] - lo) / max(hi - lo, 1e-6)
             out.append(a)
         return np.stack(out)
 
@@ -200,7 +214,16 @@ class CellposeFinetune:
         data = np.load(session.data_dir / "train.npz")
         images, flows, cellprob = data["images"], data["flows"], data["cellprob"]
         n, H, W = images.shape[:3]
+        # tile must divide through the encoder's pools or the decoder's
+        # skip concatenations misalign
+        divisor = 2 ** (len(cfg["features"]) - 1)
         tile = min(cfg["tile"], H, W)
+        if tile < divisor:
+            raise ValueError(
+                f"images ({H}x{W}) smaller than the model's minimum tile "
+                f"{divisor} for features={cfg['features']}"
+            )
+        tile = (tile // divisor) * divisor
 
         # dp over every local chip that divides the batch
         n_dev = jax.local_device_count()
@@ -213,17 +236,29 @@ class CellposeFinetune:
         model = CellposeNet(features=tuple(cfg["features"]), in_channels=2)
         rng = np.random.default_rng(cfg["seed"])
         start_epoch = 0
+        restored_state = None
+        tx = optax.adamw(cfg["learning_rate"], weight_decay=cfg["weight_decay"])
         if resume and session.latest_path.exists():
+            from flax import serialization
+
             params = load_params_npz(str(session.latest_path))
-            done = session.snapshots()
-            start_epoch = len(done)
+            start_epoch = len(session.snapshots())
+            if session.train_state_path.exists():
+                template = TrainState.create(model.apply, params, tx)
+                restored_state = serialization.from_bytes(
+                    template, session.train_state_path.read_bytes()
+                )
         else:
             params = model.init(
                 jax.random.key(cfg["seed"]),
                 jnp.zeros((1, tile, tile, 2), jnp.float32),
             )["params"]
-        tx = optax.adamw(cfg["learning_rate"], weight_decay=cfg["weight_decay"])
-        state = replicate(mesh, TrainState.create(model.apply, params, tx))
+        state = replicate(
+            mesh,
+            restored_state
+            if restored_state is not None
+            else TrainState.create(model.apply, params, tx),
+        )
         step = jit_data_parallel_step(make_train_step(), mesh)
 
         def sample_batch():
@@ -270,6 +305,11 @@ class CellposeFinetune:
             losses.append(mean_loss)
             # per-epoch snapshot feeds live inference (ref main.py:1825-1835)
             session.save_snapshot(epoch, jax.device_get(state.params))
+            from flax import serialization
+
+            session.save_train_state(
+                serialization.to_bytes(jax.device_get(state))
+            )
             session.write_status(
                 status="training",
                 current_epoch=epoch + 1,

@@ -6,6 +6,15 @@ A100 (fp16, batch 64) for DINOv2 ViT-B/14 cell-crop embedding
 Here: the same geometry in bf16 on one TPU chip via the framework's
 jitted Flax ViT. ``vs_baseline`` = images/sec / 500.
 
+Timing note: the device may sit behind an async tunnel where
+``block_until_ready`` resolves before execution finishes, so the
+harness runs ITERS forward passes inside one jitted ``lax.scan`` with a
+serial data dependency between iterations (each step's input is
+perturbed by the previous step's output mean, preventing XLA from
+hoisting the loop-invariant forward), and forces completion with a
+device->host fetch of the scalar carry. One ~65 ms round-trip is
+amortized over the whole scan.
+
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
 
@@ -26,13 +35,14 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        batch, iters, warmup = 4, 3, 1
+        batch, iters, reps = 4, 2, 1
     else:
         import jax
 
-        batch, iters, warmup = 64, 10, 3
+        batch, iters, reps = 64, 20, 3
 
     import jax.numpy as jnp
+    import numpy as np
 
     from bioengine_tpu.models.vit import ViT
 
@@ -40,17 +50,27 @@ def main() -> None:
     images = jnp.zeros((batch, 224, 224, 3), jnp.float32)
     params = model.init(jax.random.key(0), images)["params"]
 
-    fwd = jax.jit(lambda p, x: model.apply({"params": p}, x))
-    for _ in range(warmup):
-        fwd(params, images).block_until_ready()
+    def chained(params, images, n):
+        def step(carry, _):
+            x = images + carry * jnp.float32(1e-6)
+            emb = model.apply({"params": params}, x)
+            return jnp.mean(emb).astype(jnp.float32), None
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fwd(params, images)
-    out.block_until_ready()
-    dt = time.perf_counter() - t0
+        carry, _ = jax.lax.scan(step, jnp.float32(0.0), None, length=n)
+        return carry
 
-    images_per_sec = batch * iters / dt
+    run = jax.jit(chained, static_argnums=(2,))
+
+    # Warmup: compile + one real execution (fetch forces completion).
+    _ = np.asarray(run(params, images, iters))
+
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _ = np.asarray(run(params, images, iters))
+        best = min(best, time.perf_counter() - t0)
+
+    images_per_sec = batch * iters / best
     print(
         json.dumps(
             {

@@ -1,0 +1,10 @@
+"""Pallas TPU kernels for the compute hot path.
+
+Kernels run compiled via Mosaic on TPU and fall back to interpreter
+mode on the CPU backend so the hermetic test suite exercises them
+without hardware.
+"""
+
+from bioengine_tpu.ops.pallas.attention import flash_attention, make_attn_fn
+
+__all__ = ["flash_attention", "make_attn_fn"]

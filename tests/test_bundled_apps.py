@@ -500,6 +500,25 @@ class TestCellposeFinetune:
         assert status["status"] != "failed"
         await call(server, sid, "stop_training", session_id="session-stop")
 
+    async def test_odd_image_size_tile_aligned(self, cellpose_app):
+        """Images whose size is not a multiple of the U-Net divisor must
+        train (tile rounds down to the divisor) instead of crashing on a
+        skip-connection shape mismatch."""
+        result, server = cellpose_app
+        sid = result["service_id"]
+        images, masks = _synthetic_cells(size=70)
+        cfg = {**FAST_CFG, "features": [8, 16, 32], "tile": 30, "epochs": 1}
+
+        await call(
+            server, sid, "start_training",
+            train_images=images, train_labels=masks, config=cfg,
+            session_id="session-odd",
+        )
+        final = await wait_for_status(
+            server, sid, "session-odd", {"completed", "failed"}
+        )
+        assert final["status"] == "completed", final.get("error")
+
     async def test_session_id_reuse_starts_fresh(self, cellpose_app):
         result, server = cellpose_app
         sid = result["service_id"]
