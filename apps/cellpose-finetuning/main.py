@@ -158,7 +158,9 @@ class CellposeFinetune:
         convention: [cyto, nucleus])."""
         out = []
         for img in images:
-            a = np.asarray(img, np.float32)
+            # always copy: normalization below is in-place and must not
+            # write through to the caller's array
+            a = np.array(img, np.float32, copy=True)
             if a.ndim == 2:
                 a = np.stack([a, np.zeros_like(a)], axis=-1)
             elif a.ndim == 3 and a.shape[-1] == 1:
@@ -350,9 +352,22 @@ class CellposeFinetune:
         if existing is not None and (
             existing.task is None or not existing.task.done()
         ):
-            # task None = registered by a concurrent start_training that
-            # is still preparing data — treat as training to close the race
-            raise RuntimeError(f"session '{session_id}' already training")
+            # status.json is written from inside the train thread, so a
+            # terminal status can land a beat before the task resolves —
+            # let the task wind down instead of rejecting the reuse
+            terminal = existing.read_status().get("status") in (
+                "completed", "failed", "stopped",
+            )
+            if terminal and existing.task is not None:
+                await asyncio.wait_for(
+                    asyncio.shield(existing.task), timeout=30
+                )
+            else:
+                # task None = registered by a concurrent start_training
+                # still preparing data — treat as training to close the race
+                raise RuntimeError(
+                    f"session '{session_id}' already training"
+                )
         # a reused id is a fresh run: stale snapshots/data would poison
         # restart_training's epoch counting and live inference
         old_dir = self.sessions_root / session_id

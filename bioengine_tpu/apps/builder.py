@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import inspect
 import os
+import sys
 from pathlib import Path
 from typing import Any, Callable, Optional
 
@@ -71,8 +72,13 @@ class AppBuilder:
         artifact_id: Optional[str],
         version: Optional[str],
         local_path: Optional[str | Path],
-    ) -> tuple[AppManifest, dict[str, str]]:
-        """Returns (manifest, {file_stem: source_code})."""
+    ) -> tuple[AppManifest, dict[str, str], dict[str, str]]:
+        """Returns (manifest, {file_stem: source}, {sibling_stem: source}).
+
+        Siblings are top-level ``*.py`` files of the artifact that are
+        not deployment entries — apps import them as plain modules
+        (``from normalizer import ...``), matching the reference where
+        the whole app dir is the Ray runtime_env workdir."""
         local_override = os.environ.get(LOCAL_ARTIFACT_ENV)
         if local_path is None and local_override and artifact_id:
             candidate = Path(local_override) / artifact_id
@@ -85,7 +91,12 @@ class AppBuilder:
                 ref.file_stem: (base / ref.python_file).read_text()
                 for ref in manifest.deployments
             }
-            return manifest, sources
+            siblings = {
+                p.stem: p.read_text()
+                for p in sorted(base.glob("*.py"))
+                if p.stem not in sources
+            }
+            return manifest, sources, siblings
         if self.store is None or artifact_id is None:
             raise AppBuildError(
                 "need a local_path or an artifact store + artifact_id"
@@ -97,7 +108,66 @@ class AppBuilder:
             ).decode()
             for ref in manifest.deployments
         }
-        return manifest, sources
+        siblings = {}
+        for path in self.store.list_files(artifact_id, version):
+            if "/" in path or not path.endswith(".py"):
+                continue
+            stem = path[: -len(".py")]
+            if stem not in sources:
+                siblings[stem] = self.store.get_file(
+                    artifact_id, path, version
+                ).decode()
+        return manifest, sources, siblings
+
+    def _install_sibling_modules(
+        self, app_id: str, siblings: dict[str, str]
+    ) -> None:
+        """Exec sibling modules and register them in sys.modules under
+        both a namespaced key and the bare stem, so deployment code can
+        ``import normalizer`` at top level or lazily inside methods.
+
+        Replicas share this process, so a bare stem already claimed by a
+        DIFFERENT app is re-pointed at this app's module with a warning
+        — the per-app namespaced key stays unambiguous either way."""
+        import types
+
+        # Pre-register every sibling before exec'ing any, so siblings can
+        # import each other at top level regardless of file order (and
+        # circular imports behave like normal partially-initialized
+        # modules).
+        modules: dict[str, types.ModuleType] = {}
+        for stem in siblings:
+            module = types.ModuleType(stem)
+            module.__file__ = f"{stem}.py"
+            module.__bioengine_app__ = app_id
+            modules[stem] = module
+            existing = sys.modules.get(stem)
+            if existing is not None and existing is not module:
+                owner = getattr(existing, "__bioengine_app__", None)
+                if owner is None:
+                    self.logger.warning(
+                        "app '%s' module '%s' shadows an already-imported "
+                        "module of the same name for this process",
+                        app_id, stem,
+                    )
+                elif owner != app_id:
+                    self.logger.warning(
+                        "app module name '%s' already claimed by app "
+                        "'%s'; re-pointing at app '%s'",
+                        stem, owner, app_id,
+                    )
+            sys.modules[f"bioengine_app_{app_id}.{stem}"] = module
+            sys.modules[stem] = module
+        for stem, source in siblings.items():
+            try:
+                exec(
+                    compile(source, f"{stem}.py", "exec"),
+                    modules[stem].__dict__,
+                )
+            except Exception as e:
+                raise AppBuildError(
+                    f"executing app module '{stem}.py' failed: {e}"
+                ) from e
 
     # ---- exec + class extraction --------------------------------------------
 
@@ -188,7 +258,10 @@ class AppBuilder:
         make_handle: Optional[Callable[[str], Any]] = None,
         deployer: Optional[str] = None,
     ) -> BuiltApp:
-        manifest, sources = self._load_sources(artifact_id, version, local_path)
+        manifest, sources, siblings = self._load_sources(
+            artifact_id, version, local_path
+        )
+        self._install_sibling_modules(app_id, siblings)
         deployment_kwargs = dict(deployment_kwargs or {})
         env_vars = dict(env_vars or {})
 

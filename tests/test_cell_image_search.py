@@ -1,0 +1,337 @@
+"""cell-image-search app: index variants, normalizer, crop extraction,
+ingestion sessions, search — hermetic on the CPU backend (the embedder
+is the randomly-initialized ViT in pipeline-shape mode)."""
+
+import asyncio
+import importlib.util
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.integration, pytest.mark.anyio]
+
+REPO_APPS = Path(__file__).resolve().parent.parent / "apps"
+APP_DIR = REPO_APPS / "cell-image-search"
+
+
+def _load(stem):
+    """Import an app module the way the builder does (bare stem name)."""
+    if stem in sys.modules:
+        return sys.modules[stem]
+    spec = importlib.util.spec_from_file_location(stem, APP_DIR / f"{stem}.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[stem] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+normalizer = _load("normalizer")
+ingestion = _load("ingestion")
+index_mod = _load("index")
+
+
+class TestNormalizer:
+    def test_grayscale(self):
+        img = np.random.default_rng(0).normal(100, 30, (64, 64))
+        out = normalizer.to_model_input(img)
+        assert out.shape == (224, 224, 3)
+        assert out.dtype == np.float32
+
+    @pytest.mark.parametrize("c", [1, 2, 3, 4, 5])
+    def test_channel_counts(self, c):
+        img = np.random.default_rng(c).integers(
+            0, 65535, (48, 48, c)
+        ).astype(np.uint16)
+        rgb = normalizer.to_rgb_uint8(img)
+        assert rgb.shape == (48, 48, 3)
+        assert rgb.dtype == np.uint8
+
+    def test_channels_first(self):
+        img = np.random.default_rng(1).normal(size=(5, 48, 48))
+        rgb = normalizer.to_rgb_uint8(img)
+        assert rgb.shape == (48, 48, 3)
+
+    def test_percentile_stretch_outliers(self):
+        img = np.full((32, 32), 100.0)
+        img[0, 0] = 1e9  # hot pixel must not crush the range
+        out = normalizer.percentile_stretch(img)
+        assert out.max() <= 255 and out.min() >= 0
+
+    def test_decode_roundtrip(self):
+        import io
+
+        from PIL import Image
+
+        arr = np.random.default_rng(2).integers(
+            0, 255, (32, 32, 3)
+        ).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="PNG")
+        out = normalizer.decode_image_bytes(buf.getvalue())
+        np.testing.assert_array_equal(out, arr)
+
+
+class TestCropExtraction:
+    def test_finds_blobs(self):
+        _, img = next(
+            iter(ingestion.make_synthetic_images(n_images=1, size=512))
+        )
+        crops = ingestion.extract_cell_crops(img, crop_size=96, n_crops=20)
+        assert len(crops) >= 5
+        assert all(c.shape[:2] == (96, 96) for c in crops)
+
+    def test_grid_fallback_on_flat_image(self):
+        img = np.random.default_rng(0).normal(10, 0.1, (300, 300))
+        crops = ingestion.extract_cell_crops(img, crop_size=64, n_crops=9)
+        assert len(crops) >= 4
+
+
+def _random_unit(n, d=768, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    return x / np.linalg.norm(x, axis=1, keepdims=True)
+
+
+class TestIndexVariants:
+    def test_flat_exact(self, tmp_path):
+        import pandas as pd
+
+        emb = _random_unit(500)
+        df = pd.DataFrame({"compound": [f"c{i % 7}" for i in range(500)]})
+        stats = index_mod.build_index(emb, df, tmp_path)
+        assert stats["index_type"] == "FlatIP"
+        idx, meta, info = index_mod.load_index(tmp_path)
+        results = index_mod.search_index(idx, meta, emb[42], top_k=5)
+        assert results[0]["index_id"] == 42  # self-match first
+        assert results[0]["score"] > 0.99
+        assert results[0]["compound"] == "c0"
+
+    def test_ivfflat_recall(self, tmp_path):
+        emb = _random_unit(3000, seed=1)
+        idx = index_mod.IVFFlatIndex.build(emb, nlist=32, nprobe=8)
+        hits = 0
+        for q in range(50):
+            _, ids = idx.search(emb[q], 1)
+            hits += int(ids[0, 0] == q)
+        assert hits >= 45  # self-recall@1 with 8/32 probes
+
+    def test_ivfpq_recall(self, tmp_path):
+        emb = _random_unit(4000, seed=2)
+        idx = index_mod.IVFPQIndex.build(emb, nlist=16, nprobe=8)
+        hits = 0
+        for q in range(30):
+            _, ids = idx.search(emb[q], 10)
+            hits += int(q in ids[0])
+        assert hits >= 24  # PQ is lossy; self-recall@10 stays high
+
+    def test_save_load_roundtrip(self, tmp_path):
+        emb = _random_unit(1200, seed=3)
+        for built in (
+            index_mod.FlatIPIndex(emb),
+            index_mod.IVFFlatIndex.build(emb, nlist=8),
+            index_mod.IVFPQIndex.build(emb, nlist=4),
+        ):
+            p = tmp_path / f"{built.kind}.npz"
+            built.save(p)
+            with np.load(p) as data:
+                loaded = index_mod._KINDS[str(data["kind"])].load(data)
+            assert loaded.ntotal == 1200
+            s1, i1 = built.search(emb[7], 3)
+            s2, i2 = loaded.search(emb[7], 3)
+            np.testing.assert_array_equal(i1, i2)
+
+    def test_auto_selection_thresholds(self, tmp_path):
+        import pandas as pd
+
+        emb = _random_unit(200)
+        df = pd.DataFrame({"label": ["x"] * 200})
+        stats = index_mod.build_index(
+            emb, df, tmp_path, n_cells_total=200_000
+        )
+        assert stats["index_type"] == "IVFFlat"
+
+    def test_projection_and_query(self, tmp_path):
+        import pandas as pd
+
+        emb = _random_unit(800, seed=4)
+        df = pd.DataFrame({"compound": [f"c{i % 5}" for i in range(800)]})
+        index_mod.build_index(emb, df, tmp_path)
+        proj = index_mod.compute_projection(tmp_path, n_samples=200)
+        assert len(proj["x"]) == 200
+        assert proj["n_total"] == 800
+        # cached second call
+        t0 = time.time()
+        proj2 = index_mod.compute_projection(tmp_path, n_samples=200)
+        assert time.time() - t0 < 0.5
+        assert proj2["x"] == proj["x"]
+        pos = index_mod.project_query(tmp_path, emb[0])
+        assert set(pos) == {"x", "y"}
+
+
+class TestKnnOp:
+    def test_matches_numpy(self):
+        from bioengine_tpu.ops.knn import topk_inner_product
+
+        import jax.numpy as jnp
+
+        corpus = _random_unit(300, seed=5)
+        q = _random_unit(4, seed=6)
+        s, i = topk_inner_product(jnp.asarray(corpus), jnp.asarray(q), 7)
+        ref = np.argsort(-(q @ corpus.T), axis=1)[:, :7]
+        np.testing.assert_array_equal(np.asarray(i), ref)
+
+    def test_sharded_matches_flat(self, mesh8):
+        from bioengine_tpu.ops.knn import ShardedKnnIndex
+
+        corpus = _random_unit(1000, seed=7)
+        q = _random_unit(3, seed=8)
+        flat = ShardedKnnIndex(corpus, mesh=None, dtype=np.float32)
+        sharded = ShardedKnnIndex(
+            corpus, mesh=mesh8, axis="dp", dtype=np.float32
+        )
+        s1, i1 = flat.search(q, 9)
+        s2, i2 = sharded.search(q, 9)
+        np.testing.assert_array_equal(i1, i2)
+        np.testing.assert_allclose(s1, s2, atol=1e-5)
+
+    def test_sharded_uneven_corpus(self, mesh8):
+        from bioengine_tpu.ops.knn import ShardedKnnIndex
+
+        corpus = _random_unit(37, seed=9)  # not divisible by shards
+        q = _random_unit(2, seed=10)
+        idx = ShardedKnnIndex(corpus, mesh=mesh8, axis="dp", dtype=np.float32)
+        s, i = idx.search(q, 40)  # k > n clamps
+        assert i.shape == (2, 37)
+        assert (i < 37).all() and (i >= 0).all()
+
+
+# ---- full app flow through the serving stack --------------------------------
+
+
+async def deploy(manager, app_dir, **kwargs):
+    from bioengine_tpu.utils.permissions import create_context
+
+    result = await manager.deploy_app(
+        local_path=str(REPO_APPS / app_dir),
+        context=create_context("admin"),
+        **kwargs,
+    )
+    await asyncio.sleep(0.05)
+    return result
+
+
+async def call(server, service_id, method, **kwargs):
+    caller = server.validate_token(server.issue_token("user"))
+    return await server.call_service_method(
+        service_id, method, kwargs=kwargs, caller=caller
+    )
+
+
+@pytest.fixture
+async def search_app(stack, tmp_path):
+    manager, _, server, _ = stack
+    result = await deploy(
+        manager,
+        "cell-image-search",
+        deployment_kwargs={
+            "main": {
+                "workspace_dir": str(tmp_path / "ws"),
+                "batch_bucket": 8,
+                "crop_size": 64,
+                "n_crops_per_image": 8,
+            }
+        },
+    )
+    return result, server
+
+
+class TestCellImageSearchApp:
+    async def test_full_flow(self, search_app):
+        result, server = search_app
+        sid = result["service_id"]
+
+        pong = await call(server, sid, "ping")
+        assert pong["status"] == "ok"
+
+        stats = await call(server, sid, "get_index_stats")
+        assert stats["loaded"] is False
+
+        added = await call(
+            server, sid, "add_dataset",
+            name="demo", source="synthetic", n_images=2, image_size=256,
+        )
+        assert added["added"]
+
+        datasets = await call(server, sid, "list_datasets")
+        assert any(d["name"] == "demo" for d in datasets["registered"])
+
+        started = await call(
+            server, sid, "start_ingestion",
+            dataset_name="demo", session_id="s1",
+        )
+        assert started["status"] == "started"
+
+        deadline = time.time() + 600
+        status = {}
+        while time.time() < deadline:
+            status = await call(
+                server, sid, "get_ingestion_status", session_id="s1"
+            )
+            if status["status"] in ("completed", "failed"):
+                break
+            await asyncio.sleep(0.3)
+        assert status["status"] == "completed", status
+        assert status["n_embedded"] > 0
+
+        stats = await call(server, sid, "get_index_stats")
+        assert stats["loaded"] and stats["n_cells"] == status["n_embedded"]
+        assert stats["index_type"] == "FlatIP"
+
+        query = np.random.default_rng(0).normal(100, 20, (64, 64))
+        found = await call(server, sid, "search", image=query, top_k=5)
+        assert found["n_results"] == 5
+        assert found["results"][0]["rank"] == 1
+        assert found["results"][0]["dataset"] == "demo"
+
+        preview = await call(server, sid, "get_umap_preview", n_samples=10)
+        assert len(preview["x"]) == min(10, status["n_embedded"])
+        pos = await call(
+            server, sid, "project_query_onto_umap", image=query
+        )
+        assert set(pos) == {"x", "y"}
+
+        sessions = await call(server, sid, "get_active_sessions")
+        assert "s1" in sessions
+
+    async def test_stop_ingestion(self, search_app):
+        result, server = search_app
+        sid = result["service_id"]
+        await call(
+            server, sid, "add_dataset",
+            name="big", source="synthetic", n_images=50, image_size=256,
+        )
+        await call(
+            server, sid, "start_ingestion",
+            dataset_name="big", session_id="s2",
+        )
+        await call(server, sid, "stop_ingestion", session_id="s2")
+        deadline = time.time() + 600
+        while time.time() < deadline:
+            status = await call(
+                server, sid, "get_ingestion_status", session_id="s2"
+            )
+            if status["status"] in ("stopped", "completed", "failed"):
+                break
+            await asyncio.sleep(0.3)
+        assert status["status"] in ("stopped", "completed")
+
+    async def test_unknown_dataset_rejected(self, search_app):
+        result, server = search_app
+        sid = result["service_id"]
+        with pytest.raises(Exception, match="not registered"):
+            await call(
+                server, sid, "start_ingestion", dataset_name="nope"
+            )
