@@ -92,6 +92,8 @@ class BioEngineWorker:
         self.start_time: Optional[float] = None
         self._monitor_task: Optional[asyncio.Task] = None
         self._monitor_errors = 0
+        self._geo_location: Optional[dict] = None
+        self._geo_task: Optional[asyncio.Task] = None
         self._tripped = False
         self._stop_event = asyncio.Event()
         self._service_id: Optional[str] = None
@@ -149,6 +151,7 @@ class BioEngineWorker:
             )
 
         self._monitor_task = asyncio.create_task(self._monitor_loop())
+        self._geo_task = asyncio.create_task(self._fetch_geo_location())
         self.is_ready = True
         self.logger.info(
             f"worker ready: rpc={self.server.url} "
@@ -171,6 +174,9 @@ class BioEngineWorker:
             if self._monitor_task:
                 self._monitor_task.cancel()
                 self._monitor_task = None
+            if self._geo_task:
+                self._geo_task.cancel()
+                self._geo_task = None
             if self.apps_manager:
                 try:
                     admin_ctx = create_context(
@@ -299,6 +305,17 @@ class BioEngineWorker:
                         "worker tripped not-ready after repeated monitor errors"
                     )
 
+    async def _fetch_geo_location(self) -> None:
+        # geolocation for the dashboard map: one background fetch, never
+        # fatal (ref worker.py:780-883; zero-egress workers keep all-None
+        # coordinates and the monitor loop is never blocked by it)
+        from bioengine_tpu.utils.geo_location import fetch_geolocation
+
+        try:
+            self._geo_location = await fetch_geolocation(self.logger)
+        except Exception:
+            self._geo_location = {}
+
     async def _monitor_once(self) -> None:
         # cluster: liveness + scaling tick
         if not self.cluster.check_connection():
@@ -347,6 +364,7 @@ class BioEngineWorker:
                 "service_id": self._service_id,
                 "admin_users": self.admin_users,
                 "monitor_errors": self._monitor_errors,
+                "geo_location": self._geo_location or {},
             },
             "cluster": self.cluster.status,
             "applications": apps,

@@ -1,0 +1,252 @@
+"""fibsem-mito-analysis app: post-processing units + the full
+app→app composition flow (fibsem → model-runner over the framework
+RPC websocket, batched tiled inference, stitching, morphology)."""
+
+import asyncio
+import importlib.util
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.integration, pytest.mark.anyio]
+
+REPO_APPS = Path(__file__).resolve().parent.parent / "apps"
+APP_DIR = REPO_APPS / "fibsem-mito-analysis"
+
+
+def _load_cls():
+    spec = importlib.util.spec_from_file_location(
+        "fibsem_analysis", APP_DIR / "analysis_deployment.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["fibsem_analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod.MitoAnalysis
+
+
+MitoAnalysis = _load_cls()
+
+
+def _synthetic_em(size=256, n_mito=6, seed=0):
+    """EM-like image with dark elliptical blobs + the true mask."""
+    rng = np.random.default_rng(seed)
+    img = rng.normal(170, 12, (size, size)).astype(np.float32)
+    mask = np.zeros((size, size), bool)
+    yy, xx = np.mgrid[:size, :size]
+    for _ in range(n_mito):
+        cy, cx = rng.integers(40, size - 40, 2)
+        ry, rx = rng.integers(10, 22, 2)
+        blob = ((yy - cy) / ry) ** 2 + ((xx - cx) / rx) ** 2 < 1
+        img[blob] = rng.normal(60, 8, blob.sum())
+        mask |= blob
+    return img, mask
+
+
+class TestPostProcessing:
+    def test_remove_small(self):
+        binary = np.zeros((64, 64), bool)
+        binary[2:4, 2:4] = True          # 4 px — removed
+        binary[20:45, 20:45] = True      # 625 px — kept
+        out = MitoAnalysis._remove_small(binary, min_size=300)
+        assert not out[2, 2] and out[30, 30]
+
+    def test_instances_split_touching_blobs(self):
+        prob = np.zeros((128, 128), np.float32)
+        yy, xx = np.mgrid[:128, :128]
+        # two circles overlapping slightly
+        prob[((yy - 50) ** 2 + (xx - 50) ** 2) < 18**2] = 0.9
+        prob[((yy - 50) ** 2 + (xx - 85) ** 2) < 18**2] = 0.9
+        labels = MitoAnalysis._prob_to_instances(prob)
+        assert labels.max() == 2
+
+    def test_instances_empty(self):
+        labels = MitoAnalysis._prob_to_instances(
+            np.zeros((64, 64), np.float32)
+        )
+        assert labels.max() == 0
+
+    def test_region_properties_circle(self):
+        labels = np.zeros((80, 80), np.int32)
+        yy, xx = np.mgrid[:80, :80]
+        labels[((yy - 40) ** 2 + (xx - 40) ** 2) < 15**2] = 1
+        props = MitoAnalysis._region_properties(labels, pixel_um=0.005)
+        assert props["label"] == [1]
+        area_px = (labels == 1).sum()
+        np.testing.assert_allclose(
+            props["area_um2"][0], area_px * 0.005**2, rtol=1e-6
+        )
+        assert props["aspect_ratio"][0] < 1.1   # circle ≈ 1
+        assert props["eccentricity"][0] < 0.3
+        np.testing.assert_allclose(props["centroid_y"][0], 40, atol=0.5)
+
+    def test_region_properties_ellipse_axes(self):
+        labels = np.zeros((120, 120), np.int32)
+        yy, xx = np.mgrid[:120, :120]
+        labels[(((yy - 60) / 10) ** 2 + ((xx - 60) / 30) ** 2) < 1] = 1
+        props = MitoAnalysis._region_properties(labels, pixel_um=1.0)
+        np.testing.assert_allclose(
+            props["aspect_ratio"][0], 3.0, rtol=0.1
+        )
+        assert props["eccentricity"][0] > 0.9
+
+
+# ---- full composition flow --------------------------------------------------
+
+
+async def deploy(manager, app_dir, **kwargs):
+    from bioengine_tpu.utils.permissions import create_context
+
+    result = await manager.deploy_app(
+        local_path=str(REPO_APPS / app_dir),
+        context=create_context("admin"),
+        **kwargs,
+    )
+    await asyncio.sleep(0.05)
+    return result
+
+
+async def call(server, service_id, method, **kwargs):
+    caller = server.validate_token(server.issue_token("user"))
+    return await server.call_service_method(
+        service_id, method, kwargs=kwargs, caller=caller
+    )
+
+
+@pytest.fixture(scope="module")
+def seg_collection(tmp_path_factory):
+    """Local model collection with a tiny NHWC segmentation UNet whose
+    output is a brightness threshold-ish map (weights trained-free:
+    random init is fine — the fibsem flow only needs shape contracts,
+    but we bias the final conv so prob maps vary with input)."""
+    import jax
+    import jax.numpy as jnp
+    import yaml
+
+    from bioengine_tpu.models.unet import UNet2D
+    from bioengine_tpu.runtime.convert import save_params_npz
+
+    root = tmp_path_factory.mktemp("seg_collection")
+    d = root / "tiny-unet"
+    d.mkdir()
+    model = UNet2D(features=(8, 16), out_channels=1)
+    x = np.random.default_rng(0).normal(size=(1, 64, 64, 1)).astype(np.float32)
+    params = model.init(jax.random.key(0), jnp.asarray(x))["params"]
+    expected = np.asarray(
+        jax.jit(lambda p, a: model.apply({"params": p}, a))(
+            params, jnp.asarray(x)
+        )
+    )
+    save_params_npz(str(d / "weights.npz"), params)
+    np.save(d / "test_input.npy", x)
+    np.save(d / "test_output.npy", expected)
+    (d / "rdf.yaml").write_text(
+        yaml.safe_dump(
+            {
+                "type": "model",
+                "name": "Tiny UNet",
+                "description": "tiny segmentation test model",
+                "tags": ["segmentation"],
+                "inputs": [{"name": "input0", "axes": "byxc"}],
+                "outputs": [{"name": "output0", "axes": "byxc"}],
+                "test_inputs": ["test_input.npy"],
+                "test_outputs": ["test_output.npy"],
+                "documentation": "README.md",
+                "weights": {
+                    "jax_params": {
+                        "source": "weights.npz",
+                        "architecture": {
+                            "name": "unet2d",
+                            "kwargs": {
+                                "features": [8, 16],
+                                "out_channels": 1,
+                            },
+                        },
+                    }
+                },
+            }
+        )
+    )
+    (d / "README.md").write_text("# Tiny UNet")
+    return root
+
+
+@pytest.fixture
+async def fibsem_stack(stack, seg_collection, tmp_path, monkeypatch):
+    monkeypatch.setenv("BIOENGINE_LOCAL_MODEL_PATH", str(seg_collection))
+    manager, _, server, _ = stack
+    mr = await deploy(
+        manager,
+        "model-runner",
+        deployment_kwargs={
+            "entry_deployment": {"cache_dir": str(tmp_path / "cache")}
+        },
+    )
+    token = server.issue_token("fibsem-app")
+    fibsem = await deploy(
+        manager,
+        "fibsem-mito-analysis",
+        deployment_kwargs={
+            "analysis_deployment": {
+                "model_runner_service": mr["service_id"],
+                "model_id": "tiny-unet",
+                "server_url": server.url,
+                "batch_size": 4,
+                "input_layout": "NHWC",
+            }
+        },
+        env_vars={"BIOENGINE_TOKEN": token},
+    )
+    return fibsem, server
+
+
+class TestFibsemApp:
+    async def test_ping(self, fibsem_stack):
+        result, server = fibsem_stack
+        pong = await call(server, result["service_id"], "ping")
+        assert pong["status"] == "ok"
+        assert pong["model"] == "tiny-unet"
+
+    async def test_analyze_small_image(self, fibsem_stack):
+        result, server = fibsem_stack
+        img, _ = _synthetic_em(size=128)
+        out = await call(
+            server, result["service_id"], "analyze",
+            image=img, tile_size=512,
+        )
+        assert out["image_shape"] == [128, 128]
+        labels = np.asarray(out["labels"])
+        assert labels.shape == (128, 128)
+        assert out["n_mitochondria"] == len(out["properties"]["label"])
+        assert "processing_time_s" in out
+
+    async def test_analyze_tiled(self, fibsem_stack):
+        """Image larger than tile_size exercises batched tiled
+        inference + Gaussian stitch."""
+        result, server = fibsem_stack
+        img, _ = _synthetic_em(size=200)
+        out = await call(
+            server, result["service_id"], "analyze",
+            image=img, tile_size=128, overlap=32,
+        )
+        assert out["image_shape"] == [200, 200]
+        assert np.asarray(out["labels"]).shape == (200, 200)
+
+    async def test_rejects_3d(self, fibsem_stack):
+        result, server = fibsem_stack
+        with pytest.raises(Exception, match="2-D"):
+            await call(
+                server, result["service_id"], "analyze",
+                image=np.zeros((4, 8, 8)),
+            )
+
+    async def test_rejects_bad_overlap(self, fibsem_stack):
+        result, server = fibsem_stack
+        img, _ = _synthetic_em(size=200)
+        with pytest.raises(Exception, match="overlap"):
+            await call(
+                server, result["service_id"], "analyze",
+                image=img, tile_size=128, overlap=128,
+            )

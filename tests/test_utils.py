@@ -119,3 +119,73 @@ class TestRequirements:
     def test_framework_pins_present(self):
         names = [r.split("==")[0] for r in get_pip_requirements()]
         assert "numpy" in names
+
+
+class TestGeoLocation:
+    @pytest.mark.anyio
+    async def test_disabled_via_env(self, monkeypatch):
+        from bioengine_tpu.utils.geo_location import fetch_geolocation
+
+        monkeypatch.setenv("BIOENGINE_DISABLE_GEOLOCATION", "1")
+        geo = await fetch_geolocation()
+        assert geo == {
+            "region": None, "country_name": None, "country_code": None,
+            "latitude": None, "longitude": None, "timezone": None,
+        }
+
+    @pytest.mark.anyio
+    async def test_fallback_chain(self, monkeypatch):
+        """First provider fails -> second provider's answer is used."""
+        from bioengine_tpu.utils import geo_location
+
+        async def fail():
+            raise ValueError("down")
+
+        async def ok():
+            return {
+                "region": "Stockholm", "country_name": "Sweden",
+                "country_code": "SE", "latitude": 59.3,
+                "longitude": 18.1, "timezone": "Europe/Stockholm",
+            }
+
+        monkeypatch.setattr(
+            geo_location, "PROVIDERS",
+            [("down", fail), ("up", ok)],
+        )
+        geo = await geo_location.fetch_geolocation()
+        assert geo["country_code"] == "SE"
+
+    @pytest.mark.anyio
+    async def test_all_fail(self, monkeypatch):
+        from bioengine_tpu.utils import geo_location
+
+        async def fail():
+            raise ValueError("down")
+
+        monkeypatch.setattr(geo_location, "PROVIDERS", [("down", fail)])
+        geo = await geo_location.fetch_geolocation()
+        assert geo["latitude"] is None
+
+    @pytest.mark.anyio
+    async def test_centroid_fallback_when_no_coordinates(self, monkeypatch):
+        from bioengine_tpu.utils import geo_location
+
+        async def names_only():
+            return {
+                "region": "Uppsala", "country_name": "Sweden",
+                "country_code": "SE", "latitude": None,
+                "longitude": None, "timezone": "Europe/Stockholm",
+            }
+
+        async def centroid(country, region=None, logger=None):
+            assert country == "Sweden" and region == "Uppsala"
+            return {"latitude": 59.9, "longitude": 17.6}
+
+        monkeypatch.setattr(
+            geo_location, "PROVIDERS", [("names", names_only)]
+        )
+        monkeypatch.setattr(
+            geo_location, "fetch_centroid_coordinates", centroid
+        )
+        geo = await geo_location.fetch_geolocation()
+        assert geo["latitude"] == 59.9
