@@ -1,8 +1,10 @@
-"""Process-wide byte-bounded LRU cache for dataset chunks.
+"""Byte-bounded LRU cache for dataset chunks.
 
 Capability parity with ref bioengine/datasets/chunk_cache.py:18-103
 (1 GB default via env var, asyncio-lock guarded, runtime resize,
-module-level shared instance).
+module-level shared instance) — plus a host-shared variant backed by
+the native C++ shm object store so every replica process on a TPU host
+shares one chunk cache (set BIOENGINE_DATASETS_SHARED_CACHE=1).
 """
 
 from __future__ import annotations
@@ -73,5 +75,108 @@ class ChunkCache:
             self._size = 0
 
 
+class SharedChunkCache:
+    """ChunkCache API over the native shared-memory object store —
+    one cache per HOST instead of per process, so N replicas streaming
+    the same zarr dataset fetch each chunk over HTTP once.
+
+    The native store's mutex is process-shared and calls are short
+    (memcpy), so the async API simply calls through.
+    """
+
+    def __init__(
+        self,
+        max_bytes: int = DEFAULT_CACHE_SIZE,
+        name: str = "bioengine-chunks",
+    ):
+        from bioengine_tpu.native import open_store
+
+        self.max_bytes = max_bytes
+        self._name = name
+        # attach-or-create: a late-starting replica joins the existing
+        # segment — it must NEVER wipe what its siblings already cached
+        self._store = open_store(name, capacity=max_bytes, create="attach")
+
+    @property
+    def size_bytes(self) -> int:
+        return int(self._store.stats()["used_bytes"])
+
+    def __len__(self) -> int:
+        return int(self._store.stats()["n_objects"])
+
+    @property
+    def hits(self) -> int:
+        return int(self._store.stats()["hits"])
+
+    @property
+    def misses(self) -> int:
+        return int(self._store.stats()["misses"])
+
+    async def get(self, key: str) -> Optional[bytes]:
+        return self._store.get_bytes(key)
+
+    async def put(self, key: str, value: bytes) -> None:
+        if len(value) > self.max_bytes:
+            return
+        try:
+            self._store.put(key, value)
+        except FileExistsError:
+            pass  # another replica cached it first — fine
+        except OSError:
+            pass  # cache full of pinned entries: serve without caching
+
+    async def resize(self, max_bytes: int) -> None:
+        """The shm segment's capacity is fixed at creation. Shrinking
+        gates future puts; growing past the segment is impossible and
+        logged instead of silently ignored."""
+        capacity = int(self._store.stats()["capacity"])
+        if max_bytes > capacity:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "SharedChunkCache cannot grow past its shm capacity "
+                "(%d > %d); recreate the segment to grow",
+                max_bytes, capacity,
+            )
+        self.max_bytes = min(max_bytes, capacity)
+
+    async def clear(self) -> None:
+        # in place: every attached replica observes the cleared state
+        self._store.clear()
+
+
+def make_default_cache():
+    if os.environ.get("BIOENGINE_DATASETS_SHARED_CACHE"):
+        try:
+            return SharedChunkCache()
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "BIOENGINE_DATASETS_SHARED_CACHE requested but the "
+                "shared cache is unavailable (%s); falling back to a "
+                "per-process cache", e,
+            )
+    return ChunkCache()
+
+
+class _LazyDefaultCache:
+    """Defers construction to first use so importing the datasets
+    package never triggers a native build or shm creation."""
+
+    _inner = None
+
+    def _cache(self):
+        if self._inner is None:
+            self._inner = make_default_cache()
+        return self._inner
+
+    def __getattr__(self, name):
+        return getattr(self._cache(), name)
+
+    def __len__(self):
+        return len(self._cache())
+
+
 # shared across every store in the process (ref chunk_cache.py:103)
-default_cache = ChunkCache()
+default_cache = _LazyDefaultCache()

@@ -1,0 +1,461 @@
+// bioengine-tpu shared-memory object store.
+//
+// The reference runs on Ray, whose C++ core provides plasma — a
+// shared-memory object store for zero-copy object passing between the
+// worker processes on one node (SURVEY.md §2 "Native deps to replace",
+// §5.8). This is the TPU framework's equivalent: a POSIX-shm arena
+// with a process-shared robust mutex, an open-addressing key index, a
+// first-fit block allocator with coalescing, LRU eviction, and pin
+// counts so readers holding a zero-copy view block eviction of their
+// object. Python maps the same segment and serves memoryviews over it
+// (bioengine_tpu/native/store.py); replicas and data loaders on one
+// host share decoded zarr chunks and model weights without pickling.
+//
+// Layout invariants (keep the walk arithmetic exact):
+//   - Block headers are exactly ALIGN (64) bytes.
+//   - Block::size (the payload capacity) is always a multiple of ALIGN.
+//   - A block's footprint is size + ALIGN; blocks tile the data region
+//     with no gaps, so `off + b->size + ALIGN` is always the next
+//     block's payload offset.
+//
+// Build: `make` in this directory → libbioengine_store.so (ctypes ABI,
+// plain C symbols — no pybind11).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t MAGIC = 0x42494F454E47544CULL;  // "BIOENGTL"
+constexpr uint32_t VERSION = 1;
+constexpr uint32_t KEY_MAX = 112;  // bytes incl. NUL
+constexpr uint64_t ALIGN = 64;
+
+struct Header {
+  uint64_t magic;
+  uint32_t version;
+  uint32_t n_slots;
+  uint64_t capacity;      // bytes in the data region (multiple of ALIGN)
+  uint64_t data_offset;   // from segment start (multiple of ALIGN)
+  uint64_t used_bytes;    // payload capacity currently allocated
+  uint64_t clock;         // LRU tick
+  uint64_t hits, misses, evictions, put_count;
+  pthread_mutex_t mutex;  // process-shared, robust
+};
+
+struct Slot {
+  char key[KEY_MAX];
+  uint32_t state;      // 0 empty, 1 used, 2 tombstone
+  uint32_t pins;
+  uint64_t offset;     // payload offset from segment start
+  uint64_t size;       // exact user payload size (<= block capacity)
+  uint64_t last_access;
+};
+
+struct Block {
+  uint64_t size;       // payload capacity, multiple of ALIGN
+  uint64_t prev_size;  // previous block's capacity (0 = first block)
+  uint32_t used;
+  uint32_t slot;       // owning slot index when used
+  uint8_t _pad[ALIGN - 24];
+};
+static_assert(sizeof(Block) == ALIGN, "block header must be ALIGN bytes");
+
+struct Store {
+  int fd;
+  uint64_t map_size;
+  uint8_t* base;
+  Header* hdr;
+  Slot* slots;
+};
+
+inline uint64_t align_up(uint64_t v) { return (v + ALIGN - 1) & ~(ALIGN - 1); }
+
+inline Block* block_at(Store* s, uint64_t payload_off) {
+  return reinterpret_cast<Block*>(s->base + payload_off - sizeof(Block));
+}
+
+inline uint64_t first_payload_off(Store* s) {
+  return s->hdr->data_offset + sizeof(Block);
+}
+
+inline uint64_t region_end(Store* s) {
+  return s->hdr->data_offset + s->hdr->capacity;
+}
+
+uint64_t fnv1a(const char* key) {
+  uint64_t h = 1469598103934665603ULL;
+  for (const char* p = key; *p; ++p) {
+    h ^= static_cast<uint8_t>(*p);
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+int lock(Store* s) {
+  int rc = pthread_mutex_lock(&s->hdr->mutex);
+  if (rc == EOWNERDEAD) {
+    // previous holder died mid-section; flags are flipped only after
+    // list surgery so the structure is still consistent
+    pthread_mutex_consistent(&s->hdr->mutex);
+    return 0;
+  }
+  return rc;
+}
+
+void unlock(Store* s) { pthread_mutex_unlock(&s->hdr->mutex); }
+
+Slot* find_slot(Store* s, const char* key, bool for_insert) {
+  uint32_t n = s->hdr->n_slots;
+  uint64_t idx = fnv1a(key) % n;
+  Slot* tombstone = nullptr;
+  for (uint32_t probe = 0; probe < n; ++probe) {
+    Slot* sl = &s->slots[(idx + probe) % n];
+    if (sl->state == 0)
+      return for_insert ? (tombstone ? tombstone : sl) : nullptr;
+    if (sl->state == 2) {
+      if (!tombstone) tombstone = sl;
+      continue;
+    }
+    if (std::strncmp(sl->key, key, KEY_MAX) == 0) return sl;
+  }
+  return for_insert ? tombstone : nullptr;
+}
+
+void fix_next_prev(Store* s, uint64_t payload_off) {
+  Block* b = block_at(s, payload_off);
+  uint64_t nxt = payload_off + b->size + sizeof(Block);
+  if (nxt < region_end(s)) block_at(s, nxt)->prev_size = b->size;
+}
+
+void free_block(Store* s, uint64_t payload_off) {
+  Block* b = block_at(s, payload_off);
+  b->used = 0;
+  s->hdr->used_bytes -= b->size;
+  // coalesce with next
+  uint64_t nxt = payload_off + b->size + sizeof(Block);
+  if (nxt < region_end(s)) {
+    Block* nb = block_at(s, nxt);
+    if (!nb->used) {
+      b->size += sizeof(Block) + nb->size;
+      fix_next_prev(s, payload_off);
+    }
+  }
+  // coalesce with prev
+  if (b->prev_size != 0) {
+    uint64_t prev = payload_off - sizeof(Block) - b->prev_size;
+    Block* pb = block_at(s, prev);
+    if (!pb->used) {
+      pb->size += sizeof(Block) + b->size;
+      fix_next_prev(s, prev);
+    }
+  }
+}
+
+// first-fit; returns payload offset or 0. `size` is the exact user
+// size; capacity consumed is align_up(size).
+uint64_t alloc_block(Store* s, uint64_t size, uint32_t slot_idx) {
+  uint64_t need = align_up(size ? size : 1);
+  uint64_t off = first_payload_off(s);
+  while (off < region_end(s)) {
+    Block* b = block_at(s, off);
+    if (!b->used && b->size >= need) {
+      uint64_t spare = b->size - need;
+      if (spare >= sizeof(Block) + ALIGN) {
+        b->size = need;
+        uint64_t new_off = off + need + sizeof(Block);
+        Block* nb = block_at(s, new_off);
+        nb->size = spare - sizeof(Block);
+        nb->prev_size = need;
+        nb->used = 0;
+        fix_next_prev(s, new_off);
+      }
+      b->used = 1;
+      b->slot = slot_idx;
+      s->hdr->used_bytes += b->size;
+      return off;
+    }
+    off += b->size + sizeof(Block);
+  }
+  return 0;
+}
+
+// true once a free block can hold `size`
+bool fits(Store* s, uint64_t size) {
+  uint64_t need = align_up(size ? size : 1);
+  uint64_t off = first_payload_off(s);
+  while (off < region_end(s)) {
+    Block* b = block_at(s, off);
+    if (!b->used && b->size >= need) return true;
+    off += b->size + sizeof(Block);
+  }
+  return false;
+}
+
+// evict least-recently-used unpinned entries until `size` fits
+bool evict_until_fits(Store* s, uint64_t size) {
+  while (!fits(s, size)) {
+    Slot* victim = nullptr;
+    for (uint32_t i = 0; i < s->hdr->n_slots; ++i) {
+      Slot* sl = &s->slots[i];
+      if (sl->state == 1 && sl->pins == 0 &&
+          (!victim || sl->last_access < victim->last_access))
+        victim = sl;
+    }
+    if (!victim) return false;
+    free_block(s, victim->offset);
+    victim->state = 2;
+    s->hdr->evictions++;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+struct BesStats {
+  uint64_t capacity;
+  uint64_t used_bytes;
+  uint64_t n_objects;
+  uint64_t hits, misses, evictions, put_count;
+};
+
+static int bes_create_impl(const char* name, uint64_t capacity,
+                           uint32_t n_slots, bool overwrite) {
+  capacity = align_up(capacity);
+  if (overwrite) shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return -errno;
+  uint64_t slots_bytes = sizeof(Slot) * static_cast<uint64_t>(n_slots);
+  uint64_t data_offset = align_up(sizeof(Header) + slots_bytes);
+  uint64_t total = data_offset + capacity;
+  if (ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    int e = errno;
+    close(fd);
+    shm_unlink(name);
+    return -e;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    int e = errno;
+    close(fd);
+    shm_unlink(name);
+    return -e;
+  }
+  auto* hdr = static_cast<Header*>(mem);
+  std::memset(mem, 0, data_offset);
+  hdr->magic = MAGIC;
+  hdr->version = VERSION;
+  hdr->n_slots = n_slots;
+  hdr->capacity = capacity;
+  hdr->data_offset = data_offset;
+
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&hdr->mutex, &attr);
+  pthread_mutexattr_destroy(&attr);
+
+  auto* first =
+      reinterpret_cast<Block*>(static_cast<uint8_t*>(mem) + data_offset);
+  first->size = capacity - sizeof(Block);
+  first->prev_size = 0;
+  first->used = 0;
+
+  munmap(mem, total);
+  close(fd);
+  return 0;
+}
+
+// Create (or overwrite) a store segment. Returns 0 or -errno.
+int bes_create(const char* name, uint64_t capacity, uint32_t n_slots) {
+  return bes_create_impl(name, capacity, n_slots, true);
+}
+
+// Create only if absent — never unlinks an existing segment, so
+// concurrent attach-or-create races resolve to one winner.
+// Returns 0, -EEXIST, or another -errno.
+int bes_create_excl(const char* name, uint64_t capacity, uint32_t n_slots) {
+  return bes_create_impl(name, capacity, n_slots, false);
+}
+
+int bes_destroy(const char* name) {
+  return shm_unlink(name) == 0 ? 0 : -errno;
+}
+
+Store* bes_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, static_cast<uint64_t>(st.st_size),
+                   PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  auto* hdr = static_cast<Header*>(mem);
+  if (hdr->magic != MAGIC || hdr->version != VERSION) {
+    munmap(mem, static_cast<uint64_t>(st.st_size));
+    close(fd);
+    return nullptr;
+  }
+  auto* s = new Store;
+  s->fd = fd;
+  s->map_size = static_cast<uint64_t>(st.st_size);
+  s->base = static_cast<uint8_t*>(mem);
+  s->hdr = hdr;
+  s->slots = reinterpret_cast<Slot*>(s->base + sizeof(Header));
+  return s;
+}
+
+void bes_close(Store* s) {
+  if (!s) return;
+  munmap(s->base, s->map_size);
+  close(s->fd);
+  delete s;
+}
+
+// Put: copies data into the arena, evicting LRU entries as needed.
+// 0 | -EEXIST | -ENOSPC (can never fit / all pinned) | -ENAMETOOLONG |
+// -ENOMEM (slot table full).
+int bes_put(Store* s, const char* key, const void* data, uint64_t size) {
+  if (std::strlen(key) >= KEY_MAX) return -ENAMETOOLONG;
+  if (align_up(size) + sizeof(Block) > s->hdr->capacity) return -ENOSPC;
+  if (lock(s) != 0) return -EDEADLK;
+  if (find_slot(s, key, false)) {
+    unlock(s);
+    return -EEXIST;
+  }
+  Slot* sl = find_slot(s, key, true);
+  if (!sl) {
+    unlock(s);
+    return -ENOMEM;
+  }
+  uint32_t slot_idx = static_cast<uint32_t>(sl - s->slots);
+  uint64_t off = alloc_block(s, size, slot_idx);
+  if (off == 0) {
+    if (!evict_until_fits(s, size)) {
+      unlock(s);
+      return -ENOSPC;
+    }
+    off = alloc_block(s, size, slot_idx);
+    if (off == 0) {
+      unlock(s);
+      return -ENOSPC;
+    }
+  }
+  if (size) std::memcpy(s->base + off, data, size);
+  std::strncpy(sl->key, key, KEY_MAX);
+  sl->key[KEY_MAX - 1] = '\0';
+  sl->state = 1;
+  sl->pins = 0;
+  sl->offset = off;
+  sl->size = size;
+  sl->last_access = ++s->hdr->clock;
+  s->hdr->put_count++;
+  unlock(s);
+  return 0;
+}
+
+// Get + pin: bumps LRU + pin count, returns payload offset/size. The
+// caller reads bytes from its own mapping and MUST bes_release(key).
+int bes_get_pin(Store* s, const char* key, uint64_t* offset_out,
+                uint64_t* size_out) {
+  if (lock(s) != 0) return -EDEADLK;
+  Slot* sl = find_slot(s, key, false);
+  if (!sl) {
+    s->hdr->misses++;
+    unlock(s);
+    return -ENOENT;
+  }
+  sl->last_access = ++s->hdr->clock;
+  sl->pins++;
+  s->hdr->hits++;
+  *offset_out = sl->offset;
+  *size_out = sl->size;
+  unlock(s);
+  return 0;
+}
+
+int bes_release(Store* s, const char* key) {
+  if (lock(s) != 0) return -EDEADLK;
+  Slot* sl = find_slot(s, key, false);
+  if (!sl || sl->pins == 0) {
+    unlock(s);
+    return -ENOENT;
+  }
+  sl->pins--;
+  unlock(s);
+  return 0;
+}
+
+int bes_contains(Store* s, const char* key) {
+  if (lock(s) != 0) return -EDEADLK;
+  Slot* sl = find_slot(s, key, false);
+  unlock(s);
+  return sl ? 1 : 0;
+}
+
+int bes_delete(Store* s, const char* key) {
+  if (lock(s) != 0) return -EDEADLK;
+  Slot* sl = find_slot(s, key, false);
+  if (!sl) {
+    unlock(s);
+    return -ENOENT;
+  }
+  if (sl->pins > 0) {
+    unlock(s);
+    return -EBUSY;
+  }
+  free_block(s, sl->offset);
+  sl->state = 2;
+  unlock(s);
+  return 0;
+}
+
+// Clear every unpinned entry in place (the segment stays mapped by
+// all attached processes). Returns the number of entries removed.
+int bes_clear(Store* s) {
+  if (lock(s) != 0) return -EDEADLK;
+  int removed = 0;
+  for (uint32_t i = 0; i < s->hdr->n_slots; ++i) {
+    Slot* sl = &s->slots[i];
+    if (sl->state == 1 && sl->pins == 0) {
+      free_block(s, sl->offset);
+      sl->state = 2;
+      removed++;
+    }
+  }
+  unlock(s);
+  return removed;
+}
+
+int bes_stats(Store* s, BesStats* out) {
+  if (lock(s) != 0) return -EDEADLK;
+  out->capacity = s->hdr->capacity;
+  out->used_bytes = s->hdr->used_bytes;
+  uint64_t n = 0;
+  for (uint32_t i = 0; i < s->hdr->n_slots; ++i)
+    if (s->slots[i].state == 1) n++;
+  out->n_objects = n;
+  out->hits = s->hdr->hits;
+  out->misses = s->hdr->misses;
+  out->evictions = s->hdr->evictions;
+  out->put_count = s->hdr->put_count;
+  unlock(s);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,266 @@
+"""Native shared-memory object store: correctness, eviction, pinning,
+cross-process visibility, allocator stress, and the pure-Python
+fallback's API parity."""
+
+import multiprocessing as mp
+import os
+import secrets
+
+import numpy as np
+import pytest
+
+from bioengine_tpu.native import (
+    LocalObjectStore,
+    SharedObjectStore,
+    StoreError,
+    native_available,
+    open_store,
+)
+
+pytestmark = pytest.mark.unit
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="native lib unavailable"
+)
+
+
+def _xproc_child(store_name, q):
+    from bioengine_tpu.native import SharedObjectStore
+
+    cs = SharedObjectStore(store_name, create=False)
+    q.put(cs.get_bytes("from-parent"))
+    cs.put("from-child", b"child-data")
+    cs.close()
+
+
+@pytest.fixture
+def store():
+    name = f"bes-test-{secrets.token_hex(4)}"
+    s = SharedObjectStore(name, capacity=1024 * 1024, n_slots=256)
+    yield s
+    s.destroy()
+
+
+@needs_native
+class TestSharedObjectStore:
+    def test_put_get_roundtrip(self, store):
+        store.put("a", b"hello world")
+        with store.pinned("a") as view:
+            assert bytes(view) == b"hello world"
+        assert store.get_bytes("missing") is None
+
+    def test_zero_copy_view(self, store):
+        data = os.urandom(4096)
+        store.put("blob", data)
+        view = store.get("blob")
+        assert view is not None and len(view) == 4096
+        arr = np.frombuffer(view, np.uint8)  # no copy
+        assert bytes(arr.tobytes()) == data
+        del arr
+        view.release()
+        store.release("blob")
+
+    def test_duplicate_put_rejected(self, store):
+        store.put("k", b"1")
+        with pytest.raises(FileExistsError):
+            store.put("k", b"2")
+
+    def test_delete(self, store):
+        store.put("k", b"x")
+        assert store.delete("k") is True
+        assert store.delete("k") is False
+        assert store.get_bytes("k") is None
+        store.put("k", b"y")  # slot reusable after delete
+        assert store.get_bytes("k") == b"y"
+
+    def test_contains(self, store):
+        assert not store.contains("k")
+        store.put("k", b"x")
+        assert store.contains("k")
+
+    def test_lru_eviction(self):
+        name = f"bes-evict-{secrets.token_hex(4)}"
+        s = SharedObjectStore(name, capacity=64 * 1024, n_slots=64)
+        try:
+            for i in range(8):
+                s.put(f"k{i}", bytes(16 * 1024))  # 8x16K > 64K
+            stats = s.stats()
+            assert stats["evictions"] >= 4
+            # newest survives, oldest evicted
+            assert s.get_bytes("k7") is not None
+            assert s.get_bytes("k0") is None
+        finally:
+            s.destroy()
+
+    def test_pin_blocks_eviction(self):
+        name = f"bes-pin-{secrets.token_hex(4)}"
+        s = SharedObjectStore(name, capacity=64 * 1024, n_slots=64)
+        try:
+            s.put("keep", bytes(30 * 1024))
+            view = s.get("keep")  # pin it
+            s.put("a", bytes(20 * 1024))
+            s.put("b", bytes(20 * 1024))  # must evict 'a', not 'keep'
+            assert s.get_bytes("keep") is not None
+            view.release()
+            s.release("keep")
+        finally:
+            s.destroy()
+
+    def test_too_large_rejected(self, store):
+        with pytest.raises(StoreError):
+            store.put("huge", bytes(2 * 1024 * 1024))
+
+    def test_everything_pinned_enospc(self):
+        name = f"bes-full-{secrets.token_hex(4)}"
+        s = SharedObjectStore(name, capacity=64 * 1024, n_slots=64)
+        try:
+            s.put("a", bytes(50 * 1024))
+            v = s.get("a")
+            with pytest.raises(StoreError):
+                s.put("b", bytes(50 * 1024))
+            v.release()
+            s.release("a")
+            s.put("b", bytes(50 * 1024))  # now evictable
+        finally:
+            s.destroy()
+
+    def test_allocator_stress_fragmentation(self):
+        """Random put/delete churn with verification — exercises split
+        + coalesce + eviction paths."""
+        name = f"bes-stress-{secrets.token_hex(4)}"
+        s = SharedObjectStore(name, capacity=256 * 1024, n_slots=512)
+        rng = np.random.default_rng(0)
+        shadow = {}
+        try:
+            for i in range(400):
+                op = rng.random()
+                if op < 0.6 or not shadow:
+                    key = f"obj-{i}"
+                    size = int(rng.integers(1, 12000))
+                    payload = bytes([i % 256]) * size
+                    s.put(key, payload)
+                    shadow[key] = payload
+                else:
+                    key = rng.choice(list(shadow))
+                    s.delete(key)
+                    del shadow[key]
+                # spot check a few live keys (evictions allowed)
+                for k in list(shadow)[:3]:
+                    got = s.get_bytes(k)
+                    if got is not None:
+                        assert got == shadow[k], f"corruption at {k}"
+            stats = s.stats()
+            assert stats["put_count"] >= 200
+        finally:
+            s.destroy()
+
+    def test_cross_process_visibility(self):
+        name = f"bes-xproc-{secrets.token_hex(4)}"
+        s = SharedObjectStore(name, capacity=1024 * 1024, n_slots=128)
+        try:
+            s.put("from-parent", b"parent-data")
+
+            ctx = mp.get_context("spawn")
+            q = ctx.Queue()
+            p = ctx.Process(target=_xproc_child, args=(name, q))
+            p.start()
+            got = q.get(timeout=60)
+            p.join(timeout=60)
+            assert got == b"parent-data"
+            assert s.get_bytes("from-child") == b"child-data"
+        finally:
+            s.destroy()
+
+
+class TestLocalFallback:
+    def test_api_parity(self):
+        s = LocalObjectStore(capacity=1024)
+        s.put("a", b"x" * 100)
+        assert s.get_bytes("a") == b"x" * 100
+        with pytest.raises(FileExistsError):
+            s.put("a", b"y")
+        with s.pinned("a") as view:
+            assert bytes(view) == b"x" * 100
+        assert s.contains("a")
+        # eviction
+        for i in range(20):
+            s.put(f"k{i}", b"z" * 100)
+        assert s.stats()["evictions"] > 0
+        assert s.delete("k19") is True
+        s.destroy()
+        assert s.stats()["n_objects"] == 0
+
+    def test_open_store_returns_something(self):
+        name = f"bes-open-{secrets.token_hex(4)}"
+        s = open_store(name, capacity=64 * 1024, n_slots=32)
+        try:
+            s.put("k", b"v")
+            assert s.get_bytes("k") == b"v"
+        finally:
+            s.destroy()
+
+
+class TestSharedChunkCache:
+    @pytest.mark.anyio
+    async def test_chunk_cache_api(self):
+        from bioengine_tpu.datasets.chunk_cache import SharedChunkCache
+
+        name = f"bes-chunks-{secrets.token_hex(4)}"
+        cache = SharedChunkCache(max_bytes=1024 * 1024, name=name)
+        try:
+            assert await cache.get("c0") is None
+            await cache.put("c0", b"chunk-bytes")
+            assert await cache.get("c0") == b"chunk-bytes"
+            await cache.put("c0", b"chunk-bytes")  # idempotent
+            assert cache.misses >= 1 and cache.hits >= 1
+            assert len(cache) == 1
+            await cache.clear()
+            assert await cache.get("c0") is None
+        finally:
+            cache._store.destroy()
+
+    @pytest.mark.anyio
+    async def test_zarr_store_through_shared_cache(self, tmp_path):
+        """HttpZarrStore served chunks land in (and come back from) the
+        shared cache."""
+        from bioengine_tpu.datasets.chunk_cache import SharedChunkCache
+
+        name = f"bes-zc-{secrets.token_hex(4)}"
+        cache = SharedChunkCache(max_bytes=4 * 1024 * 1024, name=name)
+        try:
+            await cache.put("ds/x.zarr/c/0/0", b"\x01\x02\x03")
+            assert await cache.get("ds/x.zarr/c/0/0") == b"\x01\x02\x03"
+        finally:
+            cache._store.destroy()
+
+
+@needs_native
+class TestAttachSemantics:
+    def test_late_attach_does_not_wipe(self):
+        """A second process/handle opening the same name must join the
+        segment, not re-create it (the late-replica case)."""
+        name = f"bes-attach-{secrets.token_hex(4)}"
+        a = SharedObjectStore(name, capacity=256 * 1024)
+        try:
+            a.put("shared", b"cached-by-a")
+            b = SharedObjectStore(name, capacity=256 * 1024)  # attach
+            assert b.get_bytes("shared") == b"cached-by-a"
+            b.close()
+        finally:
+            a.destroy()
+
+    def test_in_place_clear_visible_to_all_handles(self):
+        name = f"bes-clear-{secrets.token_hex(4)}"
+        a = SharedObjectStore(name, capacity=256 * 1024)
+        b = SharedObjectStore(name, capacity=256 * 1024)
+        try:
+            a.put("k", b"v")
+            assert b.get_bytes("k") == b"v"
+            removed = b.clear()
+            assert removed == 1
+            assert a.get_bytes("k") is None
+            a.put("k2", b"v2")  # space fully reusable after clear
+            assert b.get_bytes("k2") == b"v2"
+        finally:
+            b.close()
+            a.destroy()
