@@ -1,86 +1,342 @@
-"""Headline benchmark: DINOv2-geometry ViT-B/14 embedding throughput.
+"""Headline benchmark with staged probing, retries, and diagnostics.
 
-Comparable to the reference's published number — ~500 images/sec on one
-A100 (fp16, batch 64) for DINOv2 ViT-B/14 cell-crop embedding
-(ref apps/cell-image-search/README.md:122, embedder.py:11,40-70).
-Here: the same geometry in bf16 on one TPU chip via the framework's
-jitted Flax ViT. ``vs_baseline`` = images/sec / 500.
+Measures three configs on ONE chip (the BASELINE.json set that fits a
+single device):
+
+  1. DINOv2-geometry ViT-B/14 embedding throughput (headline) — the
+     reference publishes ~500 images/sec on one A100 (fp16, batch 64)
+     for DINOv2 ViT-B/14 cell-crop embedding
+     (ref apps/cell-image-search/README.md:122, embedder.py:11,40-70).
+     ``vs_baseline`` = images/sec / 500.
+  2. U-Net 256x256 tile inference images/sec (model-runner hot path,
+     ref apps/model-runner/runtime_deployment.py:234-312).
+  3. Cellpose fine-tune train step/sec at batch 8 x 256x256
+     (ref apps/cellpose-finetuning/main.py:1278-1360).
+
+Resilience (round-1 postmortem: one backend hiccup burned the round's
+only perf artifact): the measurement runs in a SUBPROCESS so a poisoned
+backend never takes down the orchestrator; the subprocess first probes
+``jax.devices()`` with a trivial op and reports a structured probe line;
+the parent retries the whole subprocess with backoff on failure; partial
+results survive across attempts (each config reports its own line); and
+on total failure the parent still prints a valid single JSON result line
+with ``value: 0`` and a ``diagnostic`` payload (never a stack-trace
+exit).
 
 Timing note: the device may sit behind an async tunnel where
-``block_until_ready`` resolves before execution finishes, so the
-harness runs ITERS forward passes inside one jitted ``lax.scan`` with a
+``block_until_ready`` resolves before execution finishes, so each
+config runs ITERS iterations inside one jitted ``lax.scan`` with a
 serial data dependency between iterations (each step's input is
 perturbed by the previous step's output mean, preventing XLA from
-hoisting the loop-invariant forward), and forces completion with a
-device->host fetch of the scalar carry. One ~65 ms round-trip is
-amortized over the whole scan.
+hoisting the loop-invariant computation), and forces completion with a
+device->host fetch of the scalar carry. One round-trip is amortized
+over the whole scan.
 
-Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+Prints exactly ONE JSON line on stdout (the last line):
+  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N,
+   "extra": {...other configs, probe info, attempts...}}
 
-Env overrides for local debugging:
-  BENCH_PLATFORM=cpu   run on host CPU (tiny batch, not a real number)
+Env overrides:
+  BENCH_PLATFORM=cpu    run on host CPU (tiny shapes, not a real number)
+  BENCH_ATTEMPTS=N      subprocess attempts (default 3)
+  BENCH_TIMEOUT=N       per-attempt seconds (default 1500)
+  BENCH_CONFIGS=a,b,c   subset of vit,unet,cellpose
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
+BASELINE_VIT_IMG_PER_SEC = 500.0  # ref cell-image-search/README.md:122 (1x A100)
 
-def main() -> None:
-    if os.environ.get("BENCH_PLATFORM", "").lower() == "cpu":
-        import jax
+# ---------------------------------------------------------------------------
+# Worker: runs in a subprocess, prints one JSON line per stage on stdout.
+# ---------------------------------------------------------------------------
 
-        jax.config.update("jax_platforms", "cpu")
-        batch, iters, reps = 4, 2, 1
-    else:
-        import jax
 
-        batch, iters, reps = 64, 20, 3
+def _emit(obj: dict) -> None:
+    print(json.dumps(obj), flush=True)
 
-    import jax.numpy as jnp
+
+def _timed_scan(run, *args) -> float:
+    """Best-of-reps wall time for a pre-jitted serial-dependency scan."""
     import numpy as np
+
+    reps = int(os.environ.get("BENCH_REPS", "3"))
+    _ = np.asarray(run(*args))  # warmup: compile + one full execution
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _ = np.asarray(run(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _bench_vit(cpu: bool) -> dict:
+    import jax
+    import jax.numpy as jnp
 
     from bioengine_tpu.models.vit import ViT
 
+    batch, iters = (4, 2) if cpu else (64, 20)
     model = ViT(patch_size=14, dim=768, depth=12, num_heads=12)  # ViT-B/14
     images = jnp.zeros((batch, 224, 224, 3), jnp.float32)
     params = model.init(jax.random.key(0), images)["params"]
 
-    def chained(params, images, n):
+    def chained(params, images):
         def step(carry, _):
             x = images + carry * jnp.float32(1e-6)
             emb = model.apply({"params": params}, x)
             return jnp.mean(emb).astype(jnp.float32), None
 
-        carry, _ = jax.lax.scan(step, jnp.float32(0.0), None, length=n)
+        carry, _ = jax.lax.scan(step, jnp.float32(0.0), None, length=iters)
         return carry
 
-    run = jax.jit(chained, static_argnums=(2,))
+    best = _timed_scan(jax.jit(chained), params, images)
+    return {"images_per_sec": round(batch * iters / best, 2), "batch": batch}
 
-    # Warmup: compile + one real execution (fetch forces completion).
-    _ = np.asarray(run(params, images, iters))
 
-    best = float("inf")
-    for _ in range(reps):
+def _bench_unet(cpu: bool) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from bioengine_tpu.models.unet import UNet2D
+
+    batch, iters = (2, 2) if cpu else (16, 20)
+    model = UNet2D(features=(32, 64, 128, 256), out_channels=1)
+    tiles = jnp.zeros((batch, 256, 256, 1), jnp.float32)
+    params = model.init(jax.random.key(0), tiles)["params"]
+
+    def chained(params, tiles):
+        def step(carry, _):
+            x = tiles + carry * jnp.float32(1e-6)
+            out = model.apply({"params": params}, x)
+            return jnp.mean(out).astype(jnp.float32), None
+
+        carry, _ = jax.lax.scan(step, jnp.float32(0.0), None, length=iters)
+        return carry
+
+    best = _timed_scan(jax.jit(chained), params, tiles)
+    return {"images_per_sec": round(batch * iters / best, 2), "batch": batch}
+
+
+def _bench_cellpose(cpu: bool) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from bioengine_tpu.models.cellpose import (
+        CellposeConfig,
+        create_model_and_state,
+        make_train_step,
+    )
+
+    batch, hw, iters = (2, 64, 2) if cpu else (8, 256, 10)
+    _, state = create_model_and_state(
+        CellposeConfig(), jax.random.key(0), input_hw=(hw, hw)
+    )
+    step_fn = make_train_step(dp_axis=None)
+    images = jnp.zeros((batch, hw, hw, 2), jnp.float32)
+    flows = jnp.zeros((batch, hw, hw, 2), jnp.float32)
+    cellprob = jnp.zeros((batch, hw, hw), jnp.float32)
+
+    def chained(state, images, flows, cellprob):
+        def body(carry, _):
+            st, c = carry
+            x = images + c * jnp.float32(1e-6)
+            st, metrics = step_fn(st, x, flows, cellprob)
+            return (st, metrics["loss"].astype(jnp.float32)), None
+
+        (st, c), _ = jax.lax.scan(
+            body, (state, jnp.float32(0.0)), None, length=iters
+        )
+        return c
+
+    best = _timed_scan(jax.jit(chained), state, images, flows, cellprob)
+    return {"steps_per_sec": round(iters / best, 2), "batch": batch, "hw": hw}
+
+
+def worker_main() -> int:
+    cpu = os.environ.get("BENCH_PLATFORM", "").lower() == "cpu"
+    if cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    # Stage 1: probe — trivial op end-to-end before burning compile time.
+    t0 = time.perf_counter()
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        devices = jax.devices()
+        val = float(np.asarray(jnp.ones((8, 8)).sum()))
+        assert val == 64.0, f"probe op returned {val}"
+        _emit(
+            {
+                "stage": "probe",
+                "ok": True,
+                "platform": devices[0].platform,
+                "device_kind": devices[0].device_kind,
+                "n_devices": len(devices),
+                "seconds": round(time.perf_counter() - t0, 2),
+            }
+        )
+    except Exception as exc:  # noqa: BLE001 — report, don't crash
+        _emit(
+            {
+                "stage": "probe",
+                "ok": False,
+                "error": f"{type(exc).__name__}: {exc}"[:2000],
+                "seconds": round(time.perf_counter() - t0, 2),
+            }
+        )
+        return 2
+
+    # Stage 2: configs — each reports independently so partial results
+    # survive a later-config failure.
+    configs = {
+        "vit": _bench_vit,
+        "unet": _bench_unet,
+        "cellpose": _bench_cellpose,
+    }
+    wanted = [
+        n.strip()
+        for n in os.environ.get("BENCH_CONFIGS", "vit,unet,cellpose").split(",")
+    ]
+    any_fail = False
+    for name in wanted:
+        fn = configs.get(name)
+        if fn is None:
+            continue
         t0 = time.perf_counter()
-        _ = np.asarray(run(params, images, iters))
-        best = min(best, time.perf_counter() - t0)
+        try:
+            result = fn(cpu)
+            _emit(
+                {
+                    "stage": name,
+                    "ok": True,
+                    **result,
+                    "seconds": round(time.perf_counter() - t0, 2),
+                }
+            )
+        except Exception as exc:  # noqa: BLE001
+            any_fail = True
+            _emit(
+                {
+                    "stage": name,
+                    "ok": False,
+                    "error": f"{type(exc).__name__}: {exc}"[:2000],
+                    "seconds": round(time.perf_counter() - t0, 2),
+                }
+            )
+    return 1 if any_fail else 0
 
-    images_per_sec = batch * iters / best
+
+# ---------------------------------------------------------------------------
+# Orchestrator: retries the worker subprocess, merges stage lines, always
+# prints ONE final JSON line with rc 0.
+# ---------------------------------------------------------------------------
+
+
+def main() -> int:
+    if "--worker" in sys.argv:
+        return worker_main()
+
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", "3"))
+    timeout = float(os.environ.get("BENCH_TIMEOUT", "1500"))
+    backoffs = [10.0, 30.0, 60.0]
+
+    stages: dict[str, dict] = {}  # best result per stage across attempts
+    diagnostics: list[dict] = []
+
+    for attempt in range(1, attempts + 1):
+        remaining = [
+            s.strip()
+            for s in os.environ.get("BENCH_CONFIGS", "vit,unet,cellpose").split(",")
+            if s.strip() and not stages.get(s.strip(), {}).get("ok")
+        ]
+        if attempt > 1 and not remaining:
+            break
+        env = dict(os.environ)
+        if remaining:
+            env["BENCH_CONFIGS"] = ",".join(remaining)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--worker"],
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+                env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            stderr_tail = proc.stderr[-1500:] if proc.stderr else ""
+            rc = proc.returncode
+            out = proc.stdout
+        except subprocess.TimeoutExpired as exc:
+            stderr_tail = (exc.stderr or b"")[-1500:]
+            if isinstance(stderr_tail, bytes):
+                stderr_tail = stderr_tail.decode("utf-8", "replace")
+            rc = -1
+            out = (exc.stdout or b"")
+            if isinstance(out, bytes):
+                out = out.decode("utf-8", "replace")
+
+        ok_all = True
+        for line in out.splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            stage = rec.pop("stage", None)
+            if stage is None:
+                continue
+            if rec.get("ok") or stage not in stages:
+                stages[stage] = rec
+            ok_all = ok_all and bool(rec.get("ok"))
+
+        if rc == 0 and ok_all and stages:
+            break
+        diagnostics.append(
+            {
+                "attempt": attempt,
+                "rc": rc,
+                "stderr_tail": stderr_tail,
+                "probe": stages.get("probe"),
+            }
+        )
+        if attempt < attempts:
+            time.sleep(backoffs[min(attempt - 1, len(backoffs) - 1)])
+
+    vit = stages.get("vit", {})
+    value = float(vit.get("images_per_sec") or 0.0)
+    extra = {
+        "probe": stages.get("probe"),
+        "unet256": stages.get("unet"),
+        "cellpose_finetune": stages.get("cellpose"),
+        "attempts": len(diagnostics) + (1 if value else 0),
+    }
+    if diagnostics:
+        extra["diagnostics"] = diagnostics[-2:]
     print(
         json.dumps(
             {
                 "metric": "dinov2_vitb14_embed_images_per_sec_per_chip",
-                "value": round(images_per_sec, 2),
+                "value": value,
                 "unit": "images/sec",
-                "vs_baseline": round(images_per_sec / 500.0, 3),
+                "vs_baseline": round(value / BASELINE_VIT_IMG_PER_SEC, 3),
+                "extra": extra,
             }
         )
     )
+    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,367 @@
+"""Native compression codecs for the zarr layer: blosc, zstd, lz4, crc32c.
+
+The reference reads real-world OME-Zarr (JUMP plates etc.) through the
+external ``zarr>=3.0.8`` stack, whose default compressor is blosc
+(ref bioengine/datasets/http_zarr_store.py:32-245). This image ships no
+``numcodecs``, but it does ship the same underlying C libraries that
+numcodecs wraps — ``libblosc.so.1``, ``libzstd``, ``liblz4`` — so we
+bind them directly with ctypes. Wire formats are therefore bit-identical
+to what the numcodecs/zarr ecosystem produces:
+
+- blosc: the blosc1 frame format (16-byte header; cname/shuffle/clevel
+  recorded in the frame, so decode needs no out-of-band config).
+- zstd: the standard zstd frame (numcodecs ``Zstd`` / zarr v3 ``zstd``).
+- lz4: numcodecs ``LZ4`` framing — 4-byte little-endian uncompressed
+  size prefix + one LZ4 block.
+- crc32c: Castagnoli CRC32 used by zarr v3 ``sharding_indexed`` index
+  chains (pure-python table-driven; small inputs only).
+
+Every binding degrades to a clear ``CodecUnavailable`` error naming the
+missing library instead of an import-time crash, so environments without
+the shared libraries still import fine and can read gzip/zlib stores.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import functools
+import struct
+from typing import Optional
+
+__all__ = [
+    "CodecUnavailable",
+    "blosc_available",
+    "blosc_compress",
+    "blosc_decompress",
+    "zstd_compress",
+    "zstd_decompress",
+    "lz4_compress",
+    "lz4_decompress",
+    "crc32c",
+]
+
+
+class CodecUnavailable(RuntimeError):
+    """A compression library needed for this chunk isn't in the image."""
+
+
+# ---------------------------------------------------------------------------
+# blosc (libblosc.so.1 — the exact library numcodecs.Blosc wraps)
+# ---------------------------------------------------------------------------
+
+BLOSC_MAX_OVERHEAD = 16  # blosc.h: header bytes added to an uncompressible buf
+
+# numcodecs.Blosc shuffle constants (match blosc.h)
+SHUFFLE_NONE = 0
+SHUFFLE_BYTE = 1
+SHUFFLE_BIT = 2
+
+
+@functools.cache
+def _libblosc() -> Optional[ctypes.CDLL]:
+    for name in ("libblosc.so.1", "libblosc.so", ctypes.util.find_library("blosc")):
+        if not name:
+            continue
+        try:
+            lib = ctypes.CDLL(name)
+        except OSError:
+            continue
+        lib.blosc_compress_ctx.restype = ctypes.c_int
+        lib.blosc_compress_ctx.argtypes = [
+            ctypes.c_int,  # clevel
+            ctypes.c_int,  # doshuffle
+            ctypes.c_size_t,  # typesize
+            ctypes.c_size_t,  # nbytes
+            ctypes.c_void_p,  # src
+            ctypes.c_void_p,  # dest
+            ctypes.c_size_t,  # destsize
+            ctypes.c_char_p,  # compressor name
+            ctypes.c_size_t,  # blocksize (0 = automatic)
+            ctypes.c_int,  # numinternalthreads
+        ]
+        lib.blosc_decompress_ctx.restype = ctypes.c_int
+        lib.blosc_decompress_ctx.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_int,
+        ]
+        lib.blosc_cbuffer_sizes.restype = None
+        lib.blosc_cbuffer_sizes.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        return lib
+    return None
+
+
+def blosc_available() -> bool:
+    return _libblosc() is not None
+
+
+def blosc_decompress(src: bytes) -> bytes:
+    """Decompress one blosc1 frame (cname/shuffle are read from the header)."""
+    lib = _libblosc()
+    if lib is None:
+        raise CodecUnavailable(
+            "blosc chunk encountered but libblosc is not installed"
+        )
+    if len(src) < BLOSC_MAX_OVERHEAD:
+        raise ValueError(f"blosc frame too short: {len(src)} bytes")
+    nbytes = ctypes.c_size_t(0)
+    cbytes = ctypes.c_size_t(0)
+    blocksize = ctypes.c_size_t(0)
+    buf = ctypes.create_string_buffer(src, len(src))
+    lib.blosc_cbuffer_sizes(
+        buf, ctypes.byref(nbytes), ctypes.byref(cbytes), ctypes.byref(blocksize)
+    )
+    if cbytes.value != len(src):
+        raise ValueError(
+            f"blosc header reports {cbytes.value} compressed bytes, "
+            f"got {len(src)}"
+        )
+    out = ctypes.create_string_buffer(nbytes.value)
+    rc = lib.blosc_decompress_ctx(buf, out, nbytes.value, 1)
+    if rc < 0 or rc != nbytes.value:
+        raise ValueError(f"blosc decompression failed (rc={rc})")
+    return out.raw[: nbytes.value]
+
+
+def blosc_compress(
+    src: bytes,
+    typesize: int = 1,
+    cname: str = "lz4",
+    clevel: int = 5,
+    shuffle: int = SHUFFLE_BYTE,
+    blocksize: int = 0,
+) -> bytes:
+    lib = _libblosc()
+    if lib is None:
+        raise CodecUnavailable("libblosc is not installed")
+    if typesize <= 0:
+        typesize = 1
+    destsize = len(src) + BLOSC_MAX_OVERHEAD
+    out = ctypes.create_string_buffer(destsize)
+    rc = lib.blosc_compress_ctx(
+        clevel,
+        shuffle,
+        typesize,
+        len(src),
+        src,
+        out,
+        destsize,
+        cname.encode(),
+        blocksize,
+        1,
+    )
+    if rc <= 0:
+        raise ValueError(f"blosc compression failed (rc={rc}, cname={cname})")
+    return out.raw[:rc]
+
+
+# ---------------------------------------------------------------------------
+# zstd (prefer the python `zstandard` package; fall back to libzstd ctypes)
+# ---------------------------------------------------------------------------
+
+
+@functools.cache
+def _zstandard():
+    try:
+        import zstandard
+
+        return zstandard
+    except ImportError:
+        return None
+
+
+@functools.cache
+def _libzstd() -> Optional[ctypes.CDLL]:
+    for name in ("libzstd.so.1", "libzstd.so", ctypes.util.find_library("zstd")):
+        if not name:
+            continue
+        try:
+            lib = ctypes.CDLL(name)
+        except OSError:
+            continue
+        lib.ZSTD_compressBound.restype = ctypes.c_size_t
+        lib.ZSTD_compressBound.argtypes = [ctypes.c_size_t]
+        lib.ZSTD_compress.restype = ctypes.c_size_t
+        lib.ZSTD_compress.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_int,
+        ]
+        lib.ZSTD_getFrameContentSize.restype = ctypes.c_ulonglong
+        lib.ZSTD_getFrameContentSize.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+        lib.ZSTD_decompress.restype = ctypes.c_size_t
+        lib.ZSTD_decompress.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+        ]
+        lib.ZSTD_isError.restype = ctypes.c_uint
+        lib.ZSTD_isError.argtypes = [ctypes.c_size_t]
+        return lib
+    return None
+
+
+def zstd_decompress(src: bytes) -> bytes:
+    z = _zstandard()
+    if z is not None:
+        try:
+            return z.ZstdDecompressor().decompress(
+                src, max_output_size=max(len(src) * 100, 1 << 24)
+            )
+        except z.ZstdError:
+            # Frame without an embedded content size whose payload beats
+            # the guessed cap (e.g. streamed background-heavy data):
+            # fall back to incremental decompression, which has no cap.
+            dobj = z.ZstdDecompressor().decompressobj()
+            return dobj.decompress(src)
+    lib = _libzstd()
+    if lib is None:
+        raise CodecUnavailable(
+            "zstd chunk encountered but neither the zstandard package nor "
+            "libzstd is installed"
+        )
+    size = lib.ZSTD_getFrameContentSize(src, len(src))
+    if size in (2**64 - 1, 2**64 - 2):  # ERROR / CONTENTSIZE_UNKNOWN
+        raise ValueError("zstd frame without a decodable content size")
+    out = ctypes.create_string_buffer(int(size))
+    rc = lib.ZSTD_decompress(out, int(size), src, len(src))
+    if lib.ZSTD_isError(rc):
+        raise ValueError(f"zstd decompression failed (rc={rc})")
+    return out.raw[:rc]
+
+
+def zstd_compress(src: bytes, level: int = 3) -> bytes:
+    z = _zstandard()
+    if z is not None:
+        return z.ZstdCompressor(level=level).compress(src)
+    lib = _libzstd()
+    if lib is None:
+        raise CodecUnavailable("zstd compression requested but unavailable")
+    bound = lib.ZSTD_compressBound(len(src))
+    out = ctypes.create_string_buffer(bound)
+    rc = lib.ZSTD_compress(out, bound, src, len(src), level)
+    if lib.ZSTD_isError(rc):
+        raise ValueError(f"zstd compression failed (rc={rc})")
+    return out.raw[:rc]
+
+
+# ---------------------------------------------------------------------------
+# lz4 — numcodecs.LZ4 framing: u32le uncompressed size + one LZ4 block
+# ---------------------------------------------------------------------------
+
+
+@functools.cache
+def _liblz4() -> Optional[ctypes.CDLL]:
+    for name in ("liblz4.so.1", "liblz4.so", ctypes.util.find_library("lz4")):
+        if not name:
+            continue
+        try:
+            lib = ctypes.CDLL(name)
+        except OSError:
+            continue
+        lib.LZ4_compressBound.restype = ctypes.c_int
+        lib.LZ4_compressBound.argtypes = [ctypes.c_int]
+        lib.LZ4_compress_default.restype = ctypes.c_int
+        lib.LZ4_compress_default.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.LZ4_decompress_safe.restype = ctypes.c_int
+        lib.LZ4_decompress_safe.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int,
+            ctypes.c_int,
+        ]
+        return lib
+    return None
+
+
+def lz4_decompress(src: bytes) -> bytes:
+    lib = _liblz4()
+    if lib is None:
+        raise CodecUnavailable(
+            "lz4 chunk encountered but liblz4 is not installed"
+        )
+    if len(src) < 4:
+        raise ValueError("lz4 frame too short")
+    (nbytes,) = struct.unpack("<I", src[:4])
+    out = ctypes.create_string_buffer(nbytes) if nbytes else b""
+    if nbytes == 0:
+        return b""
+    rc = lib.LZ4_decompress_safe(src[4:], out, len(src) - 4, nbytes)
+    if rc < 0 or rc != nbytes:
+        raise ValueError(f"lz4 decompression failed (rc={rc})")
+    return out.raw[:nbytes]
+
+
+def lz4_compress(src: bytes) -> bytes:
+    lib = _liblz4()
+    if lib is None:
+        raise CodecUnavailable("lz4 compression requested but unavailable")
+    bound = lib.LZ4_compressBound(len(src))
+    out = ctypes.create_string_buffer(bound)
+    rc = lib.LZ4_compress_default(src, out, len(src), bound)
+    if rc <= 0:
+        raise ValueError(f"lz4 compression failed (rc={rc})")
+    return struct.pack("<I", len(src)) + out.raw[:rc]
+
+
+# ---------------------------------------------------------------------------
+# crc32c (Castagnoli) — zarr v3 chunk/shard-index checksums. Fast path:
+# the slice-by-8 C implementation in libbioengine_store (native/); pure
+# python table fallback when the native lib can't build.
+# ---------------------------------------------------------------------------
+
+
+@functools.cache
+def _crc32c_native():
+    try:
+        from bioengine_tpu.native.store import get_lib
+    except ImportError:
+        return None
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "bes_crc32c"):
+        return None
+    lib.bes_crc32c.restype = ctypes.c_uint32
+    lib.bes_crc32c.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_uint32,
+    ]
+    return lib.bes_crc32c
+
+
+@functools.cache
+def _crc32c_table() -> tuple:
+    poly = 0x82F63B78
+    table = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        table.append(crc)
+    return tuple(table)
+
+
+def crc32c(data: bytes, value: int = 0) -> int:
+    fn = _crc32c_native()
+    if fn is not None:
+        return fn(data, len(data), value)
+    table = _crc32c_table()
+    crc = value ^ 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
