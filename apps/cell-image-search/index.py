@@ -105,7 +105,6 @@ class IVFFlatIndex:
         starts = np.searchsorted(sorted_assign, np.arange(nlist))
         ends = np.searchsorted(sorted_assign, np.arange(nlist), side="right")
         self._list_bounds = np.stack([starts, ends], axis=1)
-        self._sorted_embeddings = self.embeddings[order]
 
     @classmethod
     def build(
@@ -265,16 +264,18 @@ class IVFPQIndex:
                 s0, s1 = self.list_bounds[p]
                 if s1 <= s0:
                     continue
-                # ADC table for the residual w.r.t. this list's centroid
-                resid = qr - self.centroids[p]
+                # ADC table from q itself: x_hat = c + r_hat, so
+                # q·x_hat = q·c + q·r_hat — the table scores q against
+                # the residual codebooks (FAISS IP-by-residual does the
+                # same; building it from q - c would add a spurious
+                # -c·r_hat ranking term)
                 lut = np.einsum(
                     "mkd,md->mk",
                     self.codebooks,
-                    resid.reshape(self.M, self.dsub),
+                    qr.reshape(self.M, self.dsub),
                 )  # (M, KSUB)
                 codes = self.codes[s0:s1]  # (L, M)
                 scores = lut[np.arange(self.M)[None, :], codes].sum(axis=1)
-                # inner product = q·c (constant per list) + q_resid·r
                 scores = scores + float(qr @ self.centroids[p])
                 parts_s.append(scores)
                 parts_i.append(self.ids[s0:s1])

@@ -279,6 +279,24 @@ class AppBuilder:
                 app_id,
             )
 
+        # artifact payload for remote placement: the complete app as
+        # files + the original deploy kwargs, so a worker host rebuilds
+        # the instance from source (never pickled closures) — the analog
+        # of the reference's runtime_env workdir shipped to worker nodes
+        import yaml as _yaml
+
+        payload_files = {"manifest.yaml": _yaml.safe_dump(manifest.raw)}
+        for ref in manifest.deployments:
+            payload_files[ref.python_file] = sources[ref.file_stem]
+        for stem, src in siblings.items():
+            payload_files[f"{stem}.py"] = src
+        base_payload = {
+            "app_id": app_id,
+            "files": payload_files,
+            "deployment_kwargs": deployment_kwargs,
+            "env_vars": env_vars,
+        }
+
         specs: list[DeploymentSpec] = []
         entry_ref = manifest.entry_deployment
         for ref in manifest.deployments:
@@ -306,6 +324,10 @@ class AppBuilder:
                     chips_per_replica=int(cfg.get("chips", 0)),
                     max_ongoing_requests=int(cfg.get("max_ongoing_requests", 10)),
                     autoscale=bool(cfg.get("autoscale", True)),
+                    remote_payload={
+                        **base_payload,
+                        "deployment": ref.file_stem,
+                    },
                 )
             )
 

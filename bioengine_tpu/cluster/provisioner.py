@@ -61,7 +61,17 @@ class Provisioner(abc.ABC):
         self.policy = policy or ScalingPolicy()
         self.workers: dict[str, WorkerRecord] = {}
         self._last_scale_up = 0.0
+        self.join_server_url: Optional[str] = None
+        self.join_token: Optional[str] = None
         self.logger = create_logger(self.__class__.__name__, log_file="off")
+
+    def set_join_info(self, server_url: str, token: str) -> None:
+        """Where provisioned worker_host processes should join: the
+        controller's RPC url + an admin token. Embedded into launch
+        scripts (the reference embeds the head node's Ray address the
+        same way, ref slurm_workers.py:153-296)."""
+        self.join_server_url = server_url
+        self.join_token = token
 
     # -- backend verbs --------------------------------------------------------
 
@@ -199,6 +209,13 @@ class SlurmProvisioner(Provisioner):
             )
         cpus = int(resources.get("cpus", 8))
         mem = int(resources.get("memory_gb", 32))
+        join_env = []
+        if self.join_server_url:
+            join_env.append(
+                f"export BIOENGINE_SERVER_URL={self.join_server_url}"
+            )
+        if self.join_token:
+            join_env.append(f"export BIOENGINE_ADMIN_TOKEN={self.join_token}")
         return "\n".join(
             [
                 "#!/bin/bash",
@@ -213,6 +230,7 @@ class SlurmProvisioner(Provisioner):
                     else []
                 ),
                 "set -euo pipefail",
+                *join_env,
                 f"exec {cmd}",
             ]
         )

@@ -32,6 +32,7 @@ class ReplicaRecord:
     registered_at: float
     device_ids: list[int] = field(default_factory=list)
     alive: bool = True
+    host_id: Optional[str] = None          # None = this (controller) host
     log_tail: deque = field(default_factory=lambda: deque(maxlen=500))
 
 
@@ -40,6 +41,32 @@ class PendingWorkload:
     workload_id: str
     resources: dict[str, float]            # {"chips": 1, "cpus": 2, "memory_gb": 8}
     submitted_at: float
+
+
+@dataclass
+class HostRecord:
+    """A remote worker host that joined the cluster (multi-host mode).
+
+    The reference's analog is a SLURM-launched Ray worker node joining
+    the head (ref bioengine/cluster/slurm_workers.py:153-296) whose GPUs
+    become schedulable; here a ``worker_host`` process registers its
+    chips and the controller leases them per replica."""
+
+    host_id: str
+    service_id: str                        # RPC service the host answers on
+    topology: dict
+    registered_at: float
+    chips_in_use: dict[int, str] = field(default_factory=dict)
+    alive: bool = True
+    worker_tag: Optional[str] = None       # provisioner job tag, if any
+
+    @property
+    def n_chips(self) -> int:
+        return int(self.topology.get("n_chips", 0))
+
+    def free_chip_ids(self) -> list[int]:
+        all_ids = [c["device_id"] for c in self.topology.get("chips", [])]
+        return [d for d in all_ids if d not in self.chips_in_use]
 
 
 class ClusterState:
@@ -52,6 +79,7 @@ class ClusterState:
         self._replicas: dict[str, ReplicaRecord] = {}
         self._pending: dict[str, PendingWorkload] = {}
         self._chips_in_use: dict[int, str] = {}  # device_id -> replica_id
+        self.hosts: dict[str, HostRecord] = {}   # remote worker hosts
         self.started_at = time.time()
 
     # ---- topology / resources ----------------------------------------------
@@ -90,6 +118,15 @@ class ClusterState:
             ),
             "n_replicas": sum(1 for r in self._replicas.values() if r.alive),
             "n_pending": len(self._pending),
+            "hosts": {
+                h.host_id: {
+                    "alive": h.alive,
+                    "n_chips": h.n_chips,
+                    "n_chips_free": len(h.free_chip_ids()),
+                    "worker_tag": h.worker_tag,
+                }
+                for h in self.hosts.values()
+            },
         }
         self._history.append(snap)
         return snap
@@ -137,9 +174,76 @@ class ClusterState:
             d for d, r in self._chips_in_use.items() if r == replica_id
         ]:
             del self._chips_in_use[d]
+        for host in self.hosts.values():
+            for d in [
+                d for d, r in host.chips_in_use.items() if r == replica_id
+            ]:
+                del host.chips_in_use[d]
 
     def free_chips(self) -> int:
+        """Free chips on THIS host (local placement budget)."""
         return self.topology.n_chips - len(self._chips_in_use)
+
+    def cluster_free_chips(self) -> int:
+        """Free chips across the whole cluster: local + joined hosts."""
+        return self.free_chips() + sum(
+            len(h.free_chip_ids()) for h in self.hosts.values() if h.alive
+        )
+
+    # ---- remote hosts (multi-host placement) --------------------------------
+
+    def register_host(
+        self,
+        host_id: str,
+        service_id: str,
+        topology: dict,
+        worker_tag: Optional[str] = None,
+    ) -> None:
+        self.hosts[host_id] = HostRecord(
+            host_id=host_id,
+            service_id=service_id,
+            topology=dict(topology),
+            registered_at=time.time(),
+            worker_tag=worker_tag,
+        )
+
+    def mark_host_dead(self, host_id: str) -> list[str]:
+        """Drop a host; returns the replica_ids that were leased its
+        chips so the controller can restart them elsewhere."""
+        host = self.hosts.get(host_id)
+        if host is None:
+            return []
+        host.alive = False
+        orphans = sorted(set(host.chips_in_use.values()))
+        host.chips_in_use.clear()
+        return orphans
+
+    def find_host_for_chips(self, n: int) -> Optional[HostRecord]:
+        """Least-loaded-first host with >= n free chips."""
+        candidates = [
+            h
+            for h in self.hosts.values()
+            if h.alive and len(h.free_chip_ids()) >= n
+        ]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda h: len(h.chips_in_use))
+
+    def host_acquire_chips(
+        self, host_id: str, replica_id: str, n: int
+    ) -> list[int]:
+        host = self.hosts.get(host_id)
+        if host is None or not host.alive:
+            raise RuntimeError(f"host '{host_id}' is not available")
+        free = host.free_chip_ids()
+        if len(free) < n:
+            raise RuntimeError(
+                f"host '{host_id}': need {n} chips, only {len(free)} free"
+            )
+        taken = free[:n]
+        for d in taken:
+            host.chips_in_use[d] = replica_id
+        return taken
 
     # ---- pending workloads (drive the autoscaler) ---------------------------
 
@@ -162,6 +266,7 @@ class ClusterState:
         deployment: str,
         replica_id: str,
         device_ids: Optional[list[int]] = None,
+        host_id: Optional[str] = None,
     ) -> None:
         self._replicas[replica_id] = ReplicaRecord(
             app_id=app_id,
@@ -169,6 +274,7 @@ class ClusterState:
             replica_id=replica_id,
             registered_at=time.time(),
             device_ids=device_ids or [],
+            host_id=host_id,
         )
 
     def mark_replica_dead(self, replica_id: str) -> None:

@@ -196,7 +196,13 @@ class SharedObjectStore:
 
     @contextmanager
     def pinned(self, key: str):
-        """``with store.pinned(k) as view:`` — auto-release."""
+        """``with store.pinned(k) as view:`` — auto-release.
+
+        If the caller kept an export of the view alive (np.frombuffer),
+        ``view.release()`` raises BufferError; the store pin is then
+        KEPT (the block must stay unevictable while any export points
+        into the mapping) and retried on later calls / close()."""
+        self._drain_deferred_releases()
         view = self.get(key)
         try:
             yield view
@@ -205,8 +211,22 @@ class SharedObjectStore:
                 try:
                     view.release()
                 except BufferError:
-                    pass  # caller kept an export (np.frombuffer) alive
+                    # exports alive: keep the pin so eviction can't
+                    # recycle bytes under them; retry later
+                    self._deferred_releases.append((key, view))
+                else:
+                    self.release(key)
+
+    def _drain_deferred_releases(self) -> None:
+        still_held = []
+        for key, view in self._deferred_releases:
+            try:
+                view.release()
+            except BufferError:
+                still_held.append((key, view))
+            else:
                 self.release(key)
+        self._deferred_releases = still_held
 
     def get_bytes(self, key: str) -> Optional[bytes]:
         """Copying read — no pin left behind."""

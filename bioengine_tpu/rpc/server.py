@@ -78,6 +78,7 @@ class RpcServer:
         self._clients: dict[str, web.WebSocketResponse] = {}
         self._client_users: dict[str, TokenInfo] = {}
         self._pending: dict[str, asyncio.Future] = {}
+        self._pending_owner: dict[str, str] = {}  # call_id -> provider client
         self._runner: Optional[web.AppRunner] = None
         self._site: Optional[web.TCPSite] = None
 
@@ -229,6 +230,7 @@ class RpcServer:
         call_id = uuid.uuid4().hex
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[call_id] = fut
+        self._pending_owner[call_id] = entry.owner_client
         try:
             await ws.send_bytes(
                 protocol.encode(
@@ -245,6 +247,7 @@ class RpcServer:
             return await asyncio.wait_for(fut, timeout)
         finally:
             self._pending.pop(call_id, None)
+            self._pending_owner.pop(call_id, None)
 
     def _find_service(self, full_id: str) -> ServiceEntry:
         if full_id in self._services:
@@ -315,6 +318,20 @@ class RpcServer:
         ]:
             del self._services[full_id]
             self.logger.info(f"Dropped service {full_id} (client disconnect)")
+        # fail every in-flight call routed to this client NOW — without
+        # this, callers hang for the full RPC timeout after a provider
+        # crash (a worker-host SIGKILL must fail fast so the serving
+        # controller can restart the replica elsewhere)
+        for call_id, owner in list(self._pending_owner.items()):
+            if owner != client_id:
+                continue
+            fut = self._pending.get(call_id)
+            if fut and not fut.done():
+                fut.set_exception(
+                    ConnectionError(
+                        f"provider client {client_id} disconnected mid-call"
+                    )
+                )
 
     async def _dispatch(
         self, client_id: str, ws: web.WebSocketResponse, msg: dict

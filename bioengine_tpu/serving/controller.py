@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from bioengine_tpu.cluster.state import ClusterState
+from bioengine_tpu.serving.remote import RemoteReplica
 from bioengine_tpu.serving.replica import Replica, ReplicaState
 from bioengine_tpu.utils.logger import create_logger
 
@@ -40,6 +41,10 @@ class DeploymentSpec:
     max_ongoing_requests: int = 10
     autoscale: bool = True
     target_load: float = 0.7          # scale up above, down below half
+    # artifact payload (manifest + sources + kwargs) for building this
+    # deployment on a REMOTE worker host — set by AppBuilder; None means
+    # the deployment can only be placed locally
+    remote_payload: Optional[dict] = None
 
 
 @dataclass
@@ -96,6 +101,64 @@ class ServeController:
         self._health_task: Optional[asyncio.Task] = None
         self._queue_depth: dict[tuple[str, str], int] = defaultdict(int)
         self._rr_counters: dict[tuple[str, str], itertools.count] = {}
+        self._rpc_server = None            # set by attach_rpc (multi-host)
+        self._router_admins: list[str] = []
+
+    # ---- multi-host control plane -------------------------------------------
+
+    def attach_rpc(self, server, admin_users: Optional[list[str]] = None) -> None:
+        """Enable multi-host placement: registers the ``serve-router``
+        service that (a) worker hosts join through (``register_host``)
+        and (b) remote deployments route composition calls back through
+        (``route_call`` — the cross-host analog of a Serve
+        DeploymentHandle call, ref apps/builder.py:1474-1508)."""
+        from bioengine_tpu.utils.permissions import check_permissions
+
+        self._rpc_server = server
+        self._router_admins = list(admin_users or [])
+
+        async def route_call(
+            app_id, deployment, method, args=None, kwargs=None, context=None
+        ):
+            handle = self.get_handle(app_id, deployment)
+            return await handle.call(method, *(args or []), **(kwargs or {}))
+
+        def register_host(
+            host_id, service_id, topology, worker_tag=None, context=None
+        ):
+            check_permissions(context, self._router_admins, "register_host")
+            self.cluster_state.register_host(
+                host_id, service_id, topology, worker_tag
+            )
+            self.logger.info(
+                f"host '{host_id}' joined with "
+                f"{topology.get('n_chips', 0)} chips ({service_id})"
+            )
+            return {"host_id": host_id, "registered": True}
+
+        def deregister_host(host_id, context=None):
+            check_permissions(context, self._router_admins, "deregister_host")
+            orphans = self.cluster_state.mark_host_dead(host_id)
+            return {"host_id": host_id, "orphaned_replicas": orphans}
+
+        server.register_local_service(
+            {
+                "id": "serve-router",
+                "name": "Serving controller router",
+                "type": "bioengine-serve-router",
+                "config": {"require_context": True, "visibility": "protected"},
+                "route_call": route_call,
+                "register_host": register_host,
+                "deregister_host": deregister_host,
+            }
+        )
+
+    async def _call_host(self, service_id: str, method: str, *args, **kwargs):
+        if self._rpc_server is None:
+            raise RuntimeError("controller has no RPC server attached")
+        return await self._rpc_server.call_service_method(
+            service_id, method, args, kwargs
+        )
 
     # ---- lifecycle ----------------------------------------------------------
 
@@ -143,30 +206,48 @@ class ServeController:
             raise
         return app
 
-    async def _add_replica(self, app: AppDeployment, spec: DeploymentSpec) -> Replica:
-        replica = Replica(
-            app_id=app.app_id,
-            deployment_name=spec.name,
-            instance_factory=spec.instance_factory,
-            max_ongoing_requests=spec.max_ongoing_requests,
-            log_sink=self.cluster_state.append_replica_log,
-        )
-        if spec.chips_per_replica > 0:
-            try:
-                replica.device_ids = self.cluster_state.acquire_chips(
-                    replica.replica_id, spec.chips_per_replica
-                )
-            except RuntimeError:
-                # No capacity: surface as pending workload so the
-                # provisioner can scale out (ref manager.py:239-353's
+    async def _add_replica(self, app: AppDeployment, spec: DeploymentSpec):
+        """Place one replica: locally when this host has the chips, else
+        on a joined worker host with capacity (RPC-backed RemoteReplica),
+        else enqueue a pending workload for the provisioner."""
+        replica = None
+        host_id = None
+        if spec.chips_per_replica > 0 and (
+            self.cluster_state.free_chips() < spec.chips_per_replica
+        ):
+            replica = self._make_remote_replica(app, spec)
+            if replica is None:
+                # No capacity anywhere: surface as pending workload so
+                # the provisioner can scale out (ref manager.py:239-353's
                 # SLURM headroom allowance).
                 self.cluster_state.add_pending(
                     f"{app.app_id}/{spec.name}",
                     {"chips": spec.chips_per_replica},
                 )
-                raise
+                raise RuntimeError(
+                    f"need {spec.chips_per_replica} chips for "
+                    f"{app.app_id}/{spec.name}: none free locally or on "
+                    f"any joined host"
+                )
+            host_id = replica.host_id
+        if replica is None:
+            replica = Replica(
+                app_id=app.app_id,
+                deployment_name=spec.name,
+                instance_factory=spec.instance_factory,
+                max_ongoing_requests=spec.max_ongoing_requests,
+                log_sink=self.cluster_state.append_replica_log,
+            )
+            if spec.chips_per_replica > 0:
+                replica.device_ids = self.cluster_state.acquire_chips(
+                    replica.replica_id, spec.chips_per_replica
+                )
         self.cluster_state.register_replica(
-            app.app_id, spec.name, replica.replica_id, replica.device_ids
+            app.app_id,
+            spec.name,
+            replica.replica_id,
+            replica.device_ids,
+            host_id=host_id,
         )
         try:
             await replica.start()
@@ -176,6 +257,34 @@ class ServeController:
             raise
         app.replicas[spec.name].append(replica)
         self.cluster_state.remove_pending(f"{app.app_id}/{spec.name}")
+        return replica
+
+    def _make_remote_replica(
+        self, app: AppDeployment, spec: DeploymentSpec
+    ) -> Optional["RemoteReplica"]:
+        if self._rpc_server is None or spec.remote_payload is None:
+            return None
+        self._prune_dead_hosts()  # never place on a host whose ws is gone
+        host = self.cluster_state.find_host_for_chips(spec.chips_per_replica)
+        if host is None:
+            return None
+        replica = RemoteReplica(
+            app_id=app.app_id,
+            deployment_name=spec.name,
+            host_id=host.host_id,
+            host_service_id=host.service_id,
+            call_host=self._call_host,
+            payload=spec.remote_payload,
+            max_ongoing_requests=spec.max_ongoing_requests,
+            log_sink=self.cluster_state.append_replica_log,
+        )
+        replica.device_ids = self.cluster_state.host_acquire_chips(
+            host.host_id, replica.replica_id, spec.chips_per_replica
+        )
+        self.logger.info(
+            f"placing {app.app_id}/{spec.name} on host '{host.host_id}' "
+            f"(chips {replica.device_ids})"
+        )
         return replica
 
     async def undeploy(self, app_id: str) -> None:
@@ -236,6 +345,7 @@ class ServeController:
 
     async def health_tick(self) -> None:
         """One pass: health-check replicas, restart dead ones, autoscale."""
+        self._prune_dead_hosts()
         for app in list(self.apps.values()):
             any_unhealthy = False
             for spec_name, spec in app.specs.items():
@@ -267,6 +377,24 @@ class ServeController:
                 if not alive:
                     any_unhealthy = True
             app.status = "UNHEALTHY" if any_unhealthy else "RUNNING"
+
+    def _prune_dead_hosts(self) -> None:
+        """A host whose RPC service vanished (websocket closed) is dead:
+        release its chip accounting so restarts can re-place its
+        replicas. The replicas themselves go UNHEALTHY on their next
+        check (transport error) and ride the normal restart path."""
+        if self._rpc_server is None:
+            return
+        live_services = {
+            s["id"] for s in self._rpc_server.list_services()
+        }
+        for host in list(self.cluster_state.hosts.values()):
+            if host.alive and host.service_id not in live_services:
+                orphans = self.cluster_state.mark_host_dead(host.host_id)
+                self.logger.warning(
+                    f"host '{host.host_id}' lost "
+                    f"(orphaned replicas: {orphans})"
+                )
 
     async def _autoscale(self, app: AppDeployment, spec: DeploymentSpec) -> None:
         if not spec.autoscale:

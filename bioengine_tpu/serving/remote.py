@@ -1,0 +1,157 @@
+"""RemoteReplica — an RPC-backed replica living on a joined worker host.
+
+Duck-types :class:`bioengine_tpu.serving.replica.Replica` so the
+controller's deploy / health / routing paths treat local and remote
+replicas identically — the analog of Ray Serve scheduling replica actors
+onto SLURM-joined worker nodes (ref bioengine/apps/manager.py:355-455,
+bioengine/cluster/slurm_workers.py:153-296). The instance is built ON
+the host from a shipped artifact payload (manifest + sources + kwargs —
+never pickled closures), so hosts need no shared filesystem.
+
+Host death is detected two ways: the RPC server drops a host's service
+the moment its websocket closes (so calls raise), and ``check_health``
+maps any transport error to UNHEALTHY — which makes the controller's
+normal restart path re-place the replica on another host (or locally).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Callable, Optional
+
+from bioengine_tpu.serving.replica import ReplicaState
+
+
+class RemoteReplica:
+    is_remote = True
+
+    def __init__(
+        self,
+        app_id: str,
+        deployment_name: str,
+        host_id: str,
+        host_service_id: str,
+        call_host: Callable[..., Any],     # async (service_id, method, *args, **kw)
+        payload: dict,
+        device_ids: Optional[list[int]] = None,
+        max_ongoing_requests: int = 10,
+        log_sink: Optional[Callable[[str, str], None]] = None,
+    ):
+        self.app_id = app_id
+        self.deployment_name = deployment_name
+        self.replica_id = f"{deployment_name}-{uuid.uuid4().hex[:8]}"
+        self.host_id = host_id
+        self.host_service_id = host_service_id
+        self.device_ids = device_ids or []
+        self.max_ongoing_requests = max_ongoing_requests
+        self.state = ReplicaState.STARTING
+        self.started_at = time.time()
+        self.last_error: Optional[str] = None
+        self._payload = payload
+        self._call_host = call_host
+        self._ongoing = 0
+        self._total_requests = 0
+        self._log_sink = log_sink
+
+    def _log(self, line: str) -> None:
+        if self._log_sink:
+            self._log_sink(self.replica_id, line)
+
+    # ---- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        self._log(f"placing replica on host {self.host_id}")
+        try:
+            result = await self._call_host(
+                self.host_service_id,
+                "start_replica",
+                replica_id=self.replica_id,
+                device_ids=self.device_ids,
+                max_ongoing_requests=self.max_ongoing_requests,
+                payload=self._payload,
+            )
+            self.state = ReplicaState(result["state"])
+            self._log(f"remote replica started (state={self.state})")
+        except Exception as e:
+            self.last_error = str(e)[-2000:]
+            self.state = ReplicaState.UNHEALTHY
+            self._log(f"remote start failed: {e}")
+            raise
+
+    async def check_health(self) -> ReplicaState:
+        if self.state in (ReplicaState.STOPPED, ReplicaState.UNHEALTHY):
+            return self.state
+        try:
+            import asyncio
+
+            result = await asyncio.wait_for(
+                self._call_host(
+                    self.host_service_id, "replica_health", self.replica_id
+                ),
+                timeout=30.0,
+            )
+            self.state = ReplicaState(result["state"])
+            if result.get("last_error"):
+                self.last_error = result["last_error"]
+        except Exception as e:
+            # transport failure == host gone; the controller restarts us
+            # elsewhere exactly like a crashed local replica
+            self.last_error = f"host '{self.host_id}' unreachable: {e}"
+            self.state = ReplicaState.UNHEALTHY
+            self._log(self.last_error)
+        return self.state
+
+    async def stop(self) -> None:
+        import asyncio
+
+        self.state = ReplicaState.STOPPED
+        try:
+            await asyncio.wait_for(
+                self._call_host(
+                    self.host_service_id, "stop_replica", self.replica_id
+                ),
+                timeout=15.0,
+            )
+        except Exception:
+            pass  # host already gone is a fine way to be stopped
+        self._log("remote replica stopped")
+
+    # ---- request path -------------------------------------------------------
+
+    async def call(self, method: str, *args, **kwargs) -> Any:
+        if self.state not in (ReplicaState.HEALTHY, ReplicaState.TESTING):
+            raise RuntimeError(
+                f"replica {self.replica_id} not healthy ({self.state})"
+            )
+        self._ongoing += 1
+        self._total_requests += 1
+        try:
+            return await self._call_host(
+                self.host_service_id,
+                "replica_call",
+                self.replica_id,
+                method,
+                list(args),
+                kwargs,
+            )
+        finally:
+            self._ongoing -= 1
+
+    @property
+    def load(self) -> float:
+        return self._ongoing / max(1, self.max_ongoing_requests)
+
+    def describe(self) -> dict:
+        return {
+            "replica_id": self.replica_id,
+            "deployment": self.deployment_name,
+            "state": self.state.value,
+            "device_ids": self.device_ids,
+            "host_id": self.host_id,
+            "ongoing_requests": self._ongoing,
+            "total_requests": self._total_requests,
+            "load": self.load,
+            "uptime_seconds": time.time() - self.started_at,
+            "last_error": self.last_error,
+        }

@@ -110,6 +110,9 @@ class BioEngineWorker:
         self.controller = ServeController(
             cluster_state=self.cluster.state, log_file=self.log_file
         )
+        # multi-host: register the serve-router service so worker_host
+        # processes can join and receive replica placements
+        self.controller.attach_rpc(self.server, admin_users=self.admin_users)
         await self.controller.start()
 
         artifact_store = LocalArtifactStore(self.workspace_dir / "artifacts")
@@ -141,6 +144,8 @@ class BioEngineWorker:
         self.datasets_client = self._make_datasets_client()
 
         self._write_admin_token()
+        # provisioned worker_host processes join THIS control plane
+        self.cluster.provisioner.set_join_info(self.server.url, self.admin_token)
         self._register_worker_service()
         if self.server_url:
             await self._connect_remote()

@@ -1,0 +1,322 @@
+"""Worker host — the process a provisioned node runs to JOIN the cluster.
+
+The reference's analog: a SLURM job starts ``ray start --block`` so the
+node joins the head's Ray cluster and Serve can schedule replica actors
+onto its GPUs (ref bioengine/cluster/slurm_workers.py:153-296). Here the
+join protocol is the framework's own RPC plane:
+
+1. connect to the controller's RPC server (url + admin token — the
+   provisioner embeds both in the launch command),
+2. register a ``bioengine-host-<id>`` service exposing the replica verbs
+   (start_replica / replica_call / replica_health / stop_replica),
+3. announce the local chip topology via ``serve-router.register_host``
+   so the controller can lease chips and place replicas here.
+
+Replicas are BUILT on this host from the artifact payload the controller
+ships (manifest + sources + kwargs — no pickled closures), using the
+same AppBuilder + Replica lifecycle as local placement; composition
+handles route back through the controller's ``serve-router.route_call``.
+
+Liveness is structural: when this process dies its websocket closes, the
+RPC server drops the host service, and the controller's health loop
+marks the host dead and re-places its replicas elsewhere.
+
+Run: ``python -m bioengine_tpu.worker_host --server-url ws://head:PORT/ws
+--token <admin-token>`` (this is exactly what the provisioner's sbatch
+script execs, cluster/provisioner.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import shutil
+import socket
+import sys
+import tempfile
+import uuid
+from pathlib import Path
+from typing import Any, Optional
+
+from bioengine_tpu.rpc.client import ServerConnection, connect_to_server
+from bioengine_tpu.utils.logger import create_logger
+
+
+class RouterHandle:
+    """Cross-host DeploymentHandle: composition calls from a deployment
+    hosted HERE route back through the controller's serve-router (the
+    controller then load-balances over that deployment's replicas,
+    wherever they live)."""
+
+    def __init__(self, connection: ServerConnection, app_id: str, deployment: str):
+        self._connection = connection
+        self.app_id = app_id
+        self.deployment = deployment
+
+    async def call(self, method: str, *args, **kwargs) -> Any:
+        return await self._connection.call(
+            "serve-router",
+            "route_call",
+            self.app_id,
+            self.deployment,
+            method,
+            list(args),
+            kwargs,
+        )
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        async def invoke(*args, **kwargs):
+            return await self.call(name, *args, **kwargs)
+
+        invoke.__name__ = name
+        return invoke
+
+
+class WorkerHost:
+    def __init__(
+        self,
+        server_url: str,
+        token: Optional[str] = None,
+        host_id: Optional[str] = None,
+        workspace_dir: str | Path | None = None,
+        worker_tag: Optional[str] = None,
+        log_file: Optional[str] = "off",
+    ):
+        self.server_url = server_url
+        self.token = token
+        self.host_id = host_id or f"{socket.gethostname()}-{uuid.uuid4().hex[:6]}"
+        self.worker_tag = worker_tag
+        self.workspace_dir = Path(
+            workspace_dir or tempfile.mkdtemp(prefix="bioengine-host-")
+        ).expanduser()
+        self._owns_workspace = workspace_dir is None
+        self.logger = create_logger(f"host.{self.host_id}", log_file=log_file)
+        self.connection: Optional[ServerConnection] = None
+        self.replicas: dict[str, Any] = {}
+        self.service_id: Optional[str] = None
+        self._stop_event = asyncio.Event()
+
+    # ---- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> dict:
+        from bioengine_tpu.cluster.topology import detect_topology
+
+        self.topology = detect_topology()
+        self.connection = await connect_to_server(
+            {"server_url": self.server_url, "token": self.token}
+        )
+        result = await self.connection.register_service(
+            {
+                "id": f"bioengine-host-{self.host_id}",
+                "name": f"BioEngine worker host {self.host_id}",
+                "type": "bioengine-worker-host",
+                "config": {"require_context": False, "visibility": "protected"},
+                "describe": self.describe,
+                "start_replica": self.start_replica,
+                "replica_call": self.replica_call,
+                "replica_health": self.replica_health,
+                "stop_replica": self.stop_replica,
+                "shutdown": self.shutdown,
+            }
+        )
+        self.service_id = result["id"]
+        # NB: positional — kwargs named service_id/method would collide
+        # with ServerConnection.call's own parameters
+        joined = await self.connection.call(
+            "serve-router",
+            "register_host",
+            self.host_id,
+            self.service_id,
+            self.topology.as_dict(),
+            self.worker_tag,
+        )
+        self.logger.info(
+            f"joined cluster as '{self.host_id}' "
+            f"({self.topology.n_chips} chips): {joined}"
+        )
+        return joined
+
+    async def serve_forever(self) -> None:
+        """Block until shutdown or the control-plane connection drops
+        (a supervisor/provisioner restart is the recovery path, like a
+        Ray worker losing its GCS connection)."""
+        while not self._stop_event.is_set():
+            if self.connection is None or not self.connection.connected:
+                self.logger.warning("control-plane connection lost; exiting")
+                return
+            try:
+                await asyncio.wait_for(self._stop_event.wait(), timeout=2.0)
+            except asyncio.TimeoutError:
+                pass
+
+    async def stop(self) -> None:
+        for replica_id in list(self.replicas):
+            await self.stop_replica(replica_id)
+        if self.connection is not None:
+            try:
+                await self.connection.call(
+                    "serve-router", "deregister_host", self.host_id
+                )
+            except Exception:
+                pass
+            await self.connection.disconnect()
+            self.connection = None
+        if self._owns_workspace:
+            shutil.rmtree(self.workspace_dir, ignore_errors=True)
+        self._stop_event.set()
+
+    def shutdown(self) -> dict:
+        asyncio.get_running_loop().call_soon(self._stop_event.set)
+        return {"host_id": self.host_id, "stopping": True}
+
+    # ---- replica verbs (called by the controller over RPC) ------------------
+
+    async def start_replica(
+        self,
+        replica_id: str,
+        payload: dict,
+        device_ids: Optional[list[int]] = None,
+        max_ongoing_requests: int = 10,
+    ) -> dict:
+        """Build the deployment instance from the shipped artifact
+        payload and run the standard replica lifecycle chain."""
+        from bioengine_tpu.apps.builder import AppBuilder
+        from bioengine_tpu.serving.replica import Replica
+
+        app_id = payload["app_id"]
+        deployment = payload["deployment"]
+        app_src = self.workspace_dir / "artifacts" / f"{app_id}-{replica_id}"
+        app_src.mkdir(parents=True, exist_ok=True)
+        for rel, text in payload["files"].items():
+            target = app_src / rel
+            if not target.resolve().is_relative_to(app_src.resolve()):
+                raise ValueError(f"payload path escapes app dir: {rel}")
+            target.parent.mkdir(parents=True, exist_ok=True)
+            target.write_text(text)
+
+        builder = AppBuilder(workdir_root=self.workspace_dir / "apps")
+        conn = self.connection
+        built = builder.build(
+            app_id=app_id,
+            local_path=app_src,
+            deployment_kwargs=payload.get("deployment_kwargs"),
+            env_vars=payload.get("env_vars"),
+            make_handle=lambda name, a=app_id: RouterHandle(conn, a, name),
+        )
+        spec = next(s for s in built.specs if s.name == deployment)
+        replica = Replica(
+            app_id=app_id,
+            deployment_name=deployment,
+            instance_factory=spec.instance_factory,
+            device_ids=list(device_ids or []),
+            max_ongoing_requests=max_ongoing_requests,
+        )
+        replica.replica_id = replica_id  # controller's id IS the identity
+        try:
+            await replica.start()
+        except Exception:
+            self.replicas.pop(replica_id, None)
+            raise
+        self.replicas[replica_id] = replica
+        self.logger.info(
+            f"replica {replica_id} ({app_id}/{deployment}) started "
+            f"(state={replica.state})"
+        )
+        return {"replica_id": replica_id, "state": replica.state.value}
+
+    def _get(self, replica_id: str):
+        replica = self.replicas.get(replica_id)
+        if replica is None:
+            raise KeyError(f"no replica '{replica_id}' on host {self.host_id}")
+        return replica
+
+    async def replica_call(
+        self, replica_id: str, method: str, args: list, kwargs: dict
+    ) -> Any:
+        return await self._get(replica_id).call(
+            method, *(args or []), **(kwargs or {})
+        )
+
+    async def replica_health(self, replica_id: str) -> dict:
+        replica = self._get(replica_id)
+        state = await replica.check_health()
+        return {
+            "replica_id": replica_id,
+            "state": state.value,
+            "last_error": replica.last_error,
+        }
+
+    async def stop_replica(self, replica_id: str) -> dict:
+        replica = self.replicas.pop(replica_id, None)
+        if replica is not None:
+            await replica.stop()
+        return {"replica_id": replica_id, "stopped": replica is not None}
+
+    def describe(self) -> dict:
+        return {
+            "host_id": self.host_id,
+            "worker_tag": self.worker_tag,
+            "topology": self.topology.as_dict(),
+            "replicas": {
+                rid: r.describe() for rid, r in self.replicas.items()
+            },
+        }
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Join a BioEngine-TPU cluster as a worker host"
+    )
+    parser.add_argument(
+        "--server-url",
+        default=os.environ.get("BIOENGINE_SERVER_URL"),
+        help="controller RPC url (ws://host:port/ws); "
+        "env BIOENGINE_SERVER_URL",
+    )
+    parser.add_argument(
+        "--token",
+        default=os.environ.get("BIOENGINE_ADMIN_TOKEN"),
+        help="admin token for the control plane; env BIOENGINE_ADMIN_TOKEN",
+    )
+    parser.add_argument("--host-id", default=None)
+    parser.add_argument("--worker-tag", default=None,
+                        help="provisioner job tag (for targeted scale-down)")
+    parser.add_argument("--workspace-dir", default=None)
+    parser.add_argument(
+        "--platform",
+        default=os.environ.get("BIOENGINE_FORCE_PLATFORM"),
+        help="force a jax platform before topology detection "
+        "(e.g. 'cpu' for hermetic tests)",
+    )
+    args = parser.parse_args(argv)
+    if not args.server_url:
+        parser.error("--server-url (or BIOENGINE_SERVER_URL) is required")
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    async def run() -> int:
+        host = WorkerHost(
+            server_url=args.server_url,
+            token=args.token,
+            host_id=args.host_id,
+            workspace_dir=args.workspace_dir,
+            worker_tag=args.worker_tag,
+        )
+        await host.start()
+        try:
+            await host.serve_forever()
+        finally:
+            await host.stop()
+        return 0
+
+    return asyncio.run(run())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

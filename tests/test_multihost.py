@@ -1,0 +1,323 @@
+"""Multi-host worker runtime integration tests (VERDICT round-1 gap #1).
+
+A REAL second process (``python -m bioengine_tpu.worker_host``) joins
+the controller's RPC plane, registers its topology, gets a replica
+placed on it from a shipped artifact payload, serves calls routed
+through the controller, and — when killed — triggers a restart of its
+replica on another host. Mirrors the reference semantics of SLURM
+workers joining the Ray cluster (ref bioengine/cluster/
+slurm_workers.py:153-296) and Serve scheduling pending replicas onto
+them (ref bioengine/apps/manager.py:355-455).
+"""
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from bioengine_tpu.apps.builder import AppBuilder
+from bioengine_tpu.cluster.state import ClusterState
+from bioengine_tpu.cluster.topology import TpuTopology
+from bioengine_tpu.rpc.server import RpcServer
+from bioengine_tpu.serving.controller import DeploymentHandle, ServeController
+from bioengine_tpu.serving.remote import RemoteReplica
+from bioengine_tpu.serving.replica import ReplicaState
+
+pytestmark = [pytest.mark.integration, pytest.mark.anyio]
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+CHIP_APP_MANIFEST = """\
+name: Chip App
+id: chip-app
+id_emoji: "\U0001F9EA"
+description: needs chips, so it must be placed on a worker host
+type: tpu-serve
+version: 1.0.0
+deployments:
+  - chip_deployment:ChipDeployment
+authorized_users: ["*"]
+deployment_config:
+  chip_deployment:
+    num_replicas: 1
+    max_replicas: 2
+    chips: 2
+    autoscale: false
+"""
+
+CHIP_APP_SOURCE = '''\
+import os
+import socket
+
+from bioengine_tpu.rpc import schema_method
+
+
+class ChipDeployment:
+    def __init__(self, tag: str = "none"):
+        self.tag = tag
+
+    async def async_init(self):
+        self.pid = os.getpid()
+
+    @schema_method
+    async def where(self, context=None):
+        """Report which process/host this replica runs in."""
+        return {"pid": self.pid, "hostname": socket.gethostname(),
+                "tag": self.tag}
+
+    @schema_method
+    async def add(self, a: int, b: int, context=None):
+        """Add two ints (routing smoke check)."""
+        return {"sum": a + b}
+'''
+
+COMPO_MANIFEST = """\
+name: Compo App
+id: compo-app
+id_emoji: "\U0001F517"
+description: remote entry composing a local sibling through the router
+type: tpu-serve
+version: 1.0.0
+deployments:
+  - entry_dep:EntryDep
+  - backend_dep:BackendDep
+authorized_users: ["*"]
+deployment_config:
+  entry_dep:
+    chips: 2
+    autoscale: false
+  backend_dep:
+    chips: 0
+    autoscale: false
+"""
+
+COMPO_ENTRY = '''\
+from bioengine_tpu.rpc import schema_method
+
+
+class EntryDep:
+    def __init__(self, backend_dep):
+        self.backend = backend_dep
+
+    @schema_method
+    async def compute(self, x: int, context=None):
+        """Delegate to the backend deployment via its handle."""
+        doubled = await self.backend.call("double", x)
+        return {"result": doubled["value"] + 1}
+'''
+
+COMPO_BACKEND = '''\
+import os
+
+from bioengine_tpu.rpc import schema_method
+
+
+class BackendDep:
+    def __init__(self):
+        self.pid = os.getpid()
+
+    @schema_method
+    async def double(self, x: int, context=None):
+        """Double a number; reports its pid for placement assertions."""
+        return {"value": 2 * x, "pid": self.pid}
+'''
+
+
+def _no_local_chips() -> ClusterState:
+    """A controller host with ZERO local chips — every chip-requiring
+    replica must go to a joined worker host."""
+    return ClusterState(
+        TpuTopology(chips=(), n_hosts=1, platform="cpu")
+    )
+
+
+@pytest.fixture()
+async def control_plane(tmp_path):
+    server = RpcServer(host="127.0.0.1", admin_users=["admin"])
+    await server.start()
+    token = server.issue_token("admin", is_admin=True)
+    controller = ServeController(_no_local_chips(), health_check_period=3600)
+    controller.attach_rpc(server, admin_users=["admin"])
+    await controller.start()
+    try:
+        yield server, controller, token
+    finally:
+        await controller.stop()
+        await server.stop()
+
+
+def _spawn_host(server_url: str, token: str, host_id: str, tmp_path: Path):
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "PYTHONPATH": str(REPO_ROOT),
+        }
+    )
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "bioengine_tpu.worker_host",
+            "--server-url", server_url,
+            "--token", token,
+            "--host-id", host_id,
+            "--platform", "cpu",
+            "--workspace-dir", str(tmp_path / f"ws-{host_id}"),
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+async def _wait_for_host(controller: ServeController, host_id: str, timeout=40):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        host = controller.cluster_state.hosts.get(host_id)
+        if host is not None and host.alive:
+            return host
+        await asyncio.sleep(0.2)
+    raise TimeoutError(f"host {host_id} never joined")
+
+
+def _write_app(tmp_path: Path, manifest: str, files: dict) -> Path:
+    app_dir = tmp_path / "app-src"
+    app_dir.mkdir(exist_ok=True)
+    (app_dir / "manifest.yaml").write_text(manifest)
+    for name, text in files.items():
+        (app_dir / name).write_text(text)
+    return app_dir
+
+
+async def test_host_join_place_route_and_failover(control_plane, tmp_path):
+    server, controller, token = control_plane
+    app_dir = _write_app(
+        tmp_path, CHIP_APP_MANIFEST, {"chip_deployment.py": CHIP_APP_SOURCE}
+    )
+    builder = AppBuilder(workdir_root=tmp_path / "apps")
+    built = builder.build(
+        app_id="chip-app",
+        local_path=app_dir,
+        deployment_kwargs={"chip_deployment": {"tag": "multihost"}},
+    )
+
+    host1 = _spawn_host(server.url, token, "h1", tmp_path)
+    try:
+        rec1 = await _wait_for_host(controller, "h1")
+        assert rec1.n_chips == 4
+
+        # ---- placement: zero local chips, so the replica MUST be remote
+        await controller.deploy("chip-app", built.specs)
+        replicas = controller.apps["chip-app"].replicas["chip_deployment"]
+        assert len(replicas) == 1
+        replica = replicas[0]
+        assert isinstance(replica, RemoteReplica)
+        assert replica.host_id == "h1"
+        assert len(replica.device_ids) == 2
+        # per-host chip accounting
+        assert len(rec1.chips_in_use) == 2
+        assert controller.cluster_state.cluster_free_chips() == 2
+
+        # ---- a call routes through the controller to the host process
+        handle = controller.get_handle("chip-app", "chip_deployment")
+        where = await handle.call("where")
+        assert where["pid"] == host1.pid  # actually ran over there
+        assert where["tag"] == "multihost"  # kwargs shipped with payload
+        add = await handle.call("add", 20, 22)
+        assert add["sum"] == 42
+
+        # ---- failover: kill h1, health tick re-places on h2
+        host2 = _spawn_host(server.url, token, "h2", tmp_path)
+        try:
+            await _wait_for_host(controller, "h2")
+            host1.send_signal(signal.SIGKILL)
+            host1.wait(timeout=10)
+            # let the RPC server notice the closed websocket
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                await controller.health_tick()
+                reps = controller.apps["chip-app"].replicas["chip_deployment"]
+                healthy = [
+                    r for r in reps
+                    if r.state in (ReplicaState.HEALTHY, ReplicaState.TESTING)
+                ]
+                if healthy and getattr(healthy[0], "host_id", None) == "h2":
+                    break
+                await asyncio.sleep(0.3)
+            assert not controller.cluster_state.hosts["h1"].alive
+            reps = controller.apps["chip-app"].replicas["chip_deployment"]
+            healthy = [
+                r for r in reps
+                if r.state in (ReplicaState.HEALTHY, ReplicaState.TESTING)
+            ]
+            assert len(healthy) == 1
+            assert healthy[0].host_id == "h2"
+            where2 = await handle.call("where")
+            assert where2["pid"] == host2.pid
+        finally:
+            host2.terminate()
+            host2.wait(timeout=10)
+    finally:
+        if host1.poll() is None:
+            host1.kill()
+            host1.wait(timeout=10)
+
+
+async def test_remote_entry_composes_local_backend_via_router(
+    control_plane, tmp_path
+):
+    """A chip-requiring ENTRY lands on the worker host; its handle to the
+    chip-free backend (placed locally on the controller) routes back
+    through serve-router.route_call."""
+    server, controller, token = control_plane
+    app_dir = _write_app(
+        tmp_path,
+        COMPO_MANIFEST,
+        {"entry_dep.py": COMPO_ENTRY, "backend_dep.py": COMPO_BACKEND},
+    )
+    builder = AppBuilder(workdir_root=tmp_path / "apps")
+    built = builder.build(
+        app_id="compo-app",
+        local_path=app_dir,
+        make_handle=lambda name: DeploymentHandle(
+            controller, "compo-app", name
+        ),
+    )
+
+    host = _spawn_host(server.url, token, "hx", tmp_path)
+    try:
+        await _wait_for_host(controller, "hx")
+        await controller.deploy("compo-app", built.specs)
+        entry = controller.apps["compo-app"].replicas["entry_dep"][0]
+        backend = controller.apps["compo-app"].replicas["backend_dep"][0]
+        assert isinstance(entry, RemoteReplica) and entry.host_id == "hx"
+        assert not isinstance(backend, RemoteReplica)
+
+        handle = controller.get_handle("compo-app", "entry_dep")
+        result = await handle.call("compute", 10)
+        assert result["result"] == 21  # 2*10 computed locally, +1 remotely
+    finally:
+        host.terminate()
+        host.wait(timeout=10)
+
+
+async def test_no_host_no_chips_raises_and_enqueues_pending(control_plane, tmp_path):
+    server, controller, token = control_plane
+    app_dir = _write_app(
+        tmp_path, CHIP_APP_MANIFEST, {"chip_deployment.py": CHIP_APP_SOURCE}
+    )
+    built = AppBuilder(workdir_root=tmp_path / "apps").build(
+        app_id="chip-app2", local_path=app_dir
+    )
+    with pytest.raises(RuntimeError, match="none free"):
+        await controller.deploy("chip-app2", built.specs)
+    pending = controller.cluster_state.pending()
+    assert any(p.workload_id == "chip-app2/chip_deployment" for p in pending)
