@@ -164,6 +164,9 @@ class SharedObjectStore:
         finally:
             os.close(fd)
         self._closed = False
+        # (key, view) pairs whose release raised BufferError because an
+        # export (np.frombuffer) was still alive; retried on later calls.
+        self._deferred_releases: list = []
 
     # ---- core API -----------------------------------------------------------
 
@@ -307,6 +310,7 @@ class LocalObjectStore:
         self._used = 0
         self._lock = threading.Lock()
         self._stats = {"hits": 0, "misses": 0, "evictions": 0, "put_count": 0}
+        self._deferred_releases: list = []
 
     def put(self, key: str, data) -> None:
         buf = bytes(data)
