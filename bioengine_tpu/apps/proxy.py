@@ -11,27 +11,13 @@ mimic-request autoscaling hack — the controller measures load natively.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Union
+from typing import Any, Optional
 
 from bioengine_tpu.apps.builder import BuiltApp
 from bioengine_tpu.rpc.server import RpcServer
 from bioengine_tpu.serving.controller import DeploymentHandle, ServeController
 from bioengine_tpu.utils.logger import create_logger
-from bioengine_tpu.utils.permissions import check_permissions
-
-# authorized_users may be a flat list (all methods) or a per-method map
-AclSpec = Union[list, dict]
-
-
-def check_method_permission(
-    acl: AclSpec, method: str, context: Optional[dict]
-) -> None:
-    """method-specific entry > wildcard entry > deny."""
-    if isinstance(acl, dict):
-        users = acl.get(method, acl.get("*"))
-    else:
-        users = acl
-    check_permissions(context, users, resource_name=f"method '{method}'")
+from bioengine_tpu.utils.permissions import check_method_permission
 
 
 class AppServiceProxy:

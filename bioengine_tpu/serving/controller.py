@@ -54,6 +54,10 @@ class AppDeployment:
     replicas: dict[str, list[Replica]] = field(default_factory=dict)
     created_at: float = field(default_factory=time.time)
     status: str = "DEPLOYING"         # DEPLOYING | RUNNING | UNHEALTHY | DEPLOY_FAILED | STOPPED
+    # per-method ACL for cross-host route_call — same shape as the app
+    # proxy's authorized_users (list = all methods, dict = per-method).
+    # None means "no ACL recorded": route_call then admits admins only.
+    acl: Any = None
 
 
 class DeploymentHandle:
@@ -112,7 +116,11 @@ class ServeController:
         and (b) remote deployments route composition calls back through
         (``route_call`` — the cross-host analog of a Serve
         DeploymentHandle call, ref apps/builder.py:1474-1508)."""
-        from bioengine_tpu.utils.permissions import check_permissions
+        from bioengine_tpu.utils.permissions import (
+            check_method_permission,
+            check_permissions,
+            is_authorized,
+        )
 
         self._rpc_server = server
         self._router_admins = list(admin_users or [])
@@ -120,6 +128,14 @@ class ServeController:
         async def route_call(
             app_id, deployment, method, args=None, kwargs=None, context=None
         ):
+            # Same per-method ACL the front-door proxy enforces
+            # (apps/proxy.py) — route_call must not be a side door.
+            # Admins (incl. worker hosts holding the admin token, whose
+            # composition handles route through here) always pass.
+            if not is_authorized(context, self._router_admins):
+                app = self.apps.get(app_id)
+                acl = app.acl if app is not None else None
+                check_method_permission(acl or [], method, context)
             handle = self.get_handle(app_id, deployment)
             return await handle.call(method, *(args or []), **(kwargs or {}))
 
@@ -176,7 +192,7 @@ class ServeController:
     # ---- deploy / undeploy --------------------------------------------------
 
     async def deploy(
-        self, app_id: str, specs: list[DeploymentSpec]
+        self, app_id: str, specs: list[DeploymentSpec], acl: Any = None
     ) -> AppDeployment:
         existing = self.apps.get(app_id)
         if existing is not None:
@@ -184,7 +200,9 @@ class ServeController:
                 del self.apps[app_id]  # failed attempt may be retried
             else:
                 raise ValueError(f"app '{app_id}' already deployed")
-        app = AppDeployment(app_id=app_id, specs={s.name: s for s in specs})
+        app = AppDeployment(
+            app_id=app_id, specs={s.name: s for s in specs}, acl=acl
+        )
         self.apps[app_id] = app
         try:
             for spec in specs:

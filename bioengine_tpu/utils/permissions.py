@@ -65,6 +65,18 @@ def check_permissions(
     )
 
 
+def check_method_permission(
+    acl: "list | dict", method: str, context: Optional[dict]
+) -> None:
+    """Per-method ACL: method-specific entry > wildcard entry > deny
+    (ref bioengine/apps/proxy_deployment.py:345-403)."""
+    if isinstance(acl, dict):
+        users = acl.get(method, acl.get("*"))
+    else:
+        users = acl
+    check_permissions(context, users, resource_name=f"method '{method}'")
+
+
 def is_authorized(
     context: Optional[dict[str, Any]], authorized_users: Optional[Iterable[str]]
 ) -> bool:
