@@ -67,6 +67,39 @@ class AppBuilder:
 
     # ---- source loading -----------------------------------------------------
 
+    def _stage_frontend(
+        self,
+        app_dir: Path,
+        artifact_id: Optional[str],
+        version: Optional[str],
+        local_path: Optional[str | Path],
+    ) -> None:
+        """Copy the app's ``frontend/`` dir (if any) into the workdir so
+        the manager can serve it as a static site (the reference hosts
+        app frontends via Hypha's artifact static-site URL, ref
+        bioengine/utils/artifact_utils.py:612-628; here the framework's
+        own server does)."""
+        import shutil
+
+        # always drop the previous deploy's copy: app_dir is reused per
+        # app_id, and a stale frontend must not survive an update that
+        # removed or renamed files
+        target = app_dir / "frontend"
+        shutil.rmtree(target, ignore_errors=True)
+        if local_path is not None:
+            src = Path(local_path) / "frontend"
+            if src.is_dir():
+                shutil.copytree(src, target)
+            return
+        if self.store is None or artifact_id is None:
+            return
+        for rel in self.store.list_files(artifact_id, version):
+            if not rel.startswith("frontend/"):
+                continue
+            out = target.parent / rel
+            out.parent.mkdir(parents=True, exist_ok=True)
+            out.write_bytes(self.store.get_file(artifact_id, rel, version))
+
     def _load_sources(
         self,
         artifact_id: Optional[str],
@@ -267,6 +300,7 @@ class AppBuilder:
 
         app_dir = self.workdir_root / app_id
         app_dir.mkdir(parents=True, exist_ok=True)
+        self._stage_frontend(app_dir, artifact_id, version, local_path)
 
         stems = [ref.file_stem for ref in manifest.deployments]
         classes: dict[str, type] = {}

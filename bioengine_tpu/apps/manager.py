@@ -60,6 +60,7 @@ class AppRecord:
     authorized_users: Optional[list[str]] = None
     env_vars: dict = field(default_factory=dict)
     redeploy_count: int = 0
+    frontend_url: Optional[str] = None
 
 
 class AppsManager:
@@ -151,9 +152,12 @@ class AppsManager:
                 deployer=deployer,
             )
             self._check_resources(built)
-            await self.controller.deploy(app_id, built.specs)
+            await self.controller.deploy(
+                app_id, built.specs, acl=built.authorized_users
+            )
             proxy = AppServiceProxy(self.server, self.controller, built)
             proxy.register()
+            frontend_url = self._register_frontend(app_id, built)
             self.records[app_id] = AppRecord(
                 app_id=app_id,
                 built=built,
@@ -169,6 +173,7 @@ class AppsManager:
                     list(authorized_users) if authorized_users is not None else None
                 ),
                 env_vars=dict(env_vars or {}),
+                frontend_url=frontend_url,
             )
             self.logger.info(
                 f"deployed '{app_id}' ({built.manifest.name}) "
@@ -179,12 +184,31 @@ class AppsManager:
                 "service_id": proxy.service_id,
                 "name": built.manifest.name,
                 "methods": sorted(built.schema_methods),
+                "frontend_url": frontend_url,
             }
+
+    def _register_frontend(self, app_id: str, built) -> Optional[str]:
+        """Serve the app's ``frontend/`` dir (if any) through the RPC
+        server's static route — the analog of the reference's
+        artifact static-site URL (ref bioengine/apps/manager.py uses
+        Hypha's site hosting; here the framework serves it itself)."""
+        if built.app_dir is None:
+            return None
+        frontend = Path(built.app_dir) / "frontend"
+        if not frontend.is_dir():
+            return None
+        register = getattr(self.server, "register_static_dir", None)
+        if register is None:
+            return None
+        return register(app_id, frontend)
 
     async def _undeploy(self, app_id: str) -> None:
         record = self.records.pop(app_id, None)
         if record is None:
             return
+        unregister = getattr(self.server, "unregister_static_dir", None)
+        if unregister is not None:
+            unregister(app_id)
         record.proxy.deregister()
         await self.controller.undeploy(app_id)
 
@@ -227,6 +251,7 @@ class AppsManager:
                 "deployed_by": record.deployed_by,
                 "deployed_at": record.deployed_at,
                 "service_id": record.proxy.service_id,
+                "frontend_url": record.frontend_url,
                 "available_methods": sorted(record.built.schema_methods),
                 "authorized_users": record.built.authorized_users,
                 # secret convention: only names, never values

@@ -33,6 +33,22 @@ from bioengine_tpu.rpc.schema import extract_schema
 from bioengine_tpu.utils.logger import create_logger
 
 
+def _to_jsonable(obj: Any) -> Any:
+    """Numpy-aware conversion for the JSON HTTP bridge (service results
+    may carry arrays, e.g. segmentation masks)."""
+    import numpy as np
+
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, dict):
+        return {k: _to_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_to_jsonable(v) for v in obj]
+    return obj
+
+
 @dataclass
 class TokenInfo:
     user_id: str
@@ -81,6 +97,7 @@ class RpcServer:
         self._pending_owner: dict[str, str] = {}  # call_id -> provider client
         self._runner: Optional[web.AppRunner] = None
         self._site: Optional[web.TCPSite] = None
+        self._static_dirs: dict[str, Any] = {}  # name -> Path
 
     # ---- lifecycle ----------------------------------------------------------
 
@@ -89,6 +106,14 @@ class RpcServer:
         app.router.add_get("/ws", self._handle_ws)
         app.router.add_get("/health/liveness", self._handle_health)
         app.router.add_get("/services", self._handle_list_http)
+        # JSON-over-HTTP bridge: what browser frontends use (the
+        # reference's frontends call Hypha services from JS, ref
+        # apps/cellpose-finetuning/frontend/index.html; here the bridge
+        # is part of the framework's own server)
+        app.router.add_post("/call/{service_id}/{method}", self._handle_call_http)
+        # dynamically registered app frontends (register_static_dir)
+        app.router.add_get("/apps/{name}", self._handle_static)
+        app.router.add_get("/apps/{name}/{rest:.*}", self._handle_static)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         self._site = web.TCPSite(self._runner, self.host, self.port)
@@ -268,6 +293,89 @@ class RpcServer:
 
     async def _handle_list_http(self, request: web.Request) -> web.Response:
         return web.json_response(self.list_services())
+
+    # ---- HTTP bridge + app frontends -----------------------------------------
+
+    def register_static_dir(self, name: str, directory) -> str:
+        """Serve ``directory`` at ``/apps/{name}/`` (an app's browser
+        frontend). Returns the URL path prefix."""
+        from pathlib import Path
+
+        self._static_dirs[name] = Path(directory).resolve()
+        return f"/apps/{name}/"
+
+    def unregister_static_dir(self, name: str) -> None:
+        self._static_dirs.pop(name, None)
+
+    async def _handle_static(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        root = self._static_dirs.get(name)
+        if root is None:
+            raise web.HTTPNotFound(reason=f"no frontend '{name}'")
+        if "rest" not in request.match_info:
+            # /apps/foo -> /apps/foo/ so the page's relative asset URLs
+            # resolve inside the frontend dir
+            raise web.HTTPFound(f"/apps/{name}/")
+        rest = request.match_info.get("rest", "") or "index.html"
+        target = (root / rest).resolve()
+        if not target.is_relative_to(root):
+            raise web.HTTPForbidden(reason="path escapes frontend dir")
+        if target.is_dir():
+            target = target / "index.html"
+        if not target.is_file():
+            raise web.HTTPNotFound()
+        return web.FileResponse(target)
+
+    def _http_caller(self, request: web.Request) -> TokenInfo:
+        token = request.query.get("token", "")
+        auth = request.headers.get("Authorization", "")
+        if auth.startswith("Bearer "):
+            token = auth[len("Bearer "):]
+        if token:
+            return self.validate_token(token)  # PermissionError -> 401
+        return TokenInfo("anonymous", self.default_workspace, time.time() + 60)
+
+    async def _handle_call_http(self, request: web.Request) -> web.Response:
+        """POST /call/{service_id}/{method} with JSON body
+        ``{"args": [...], "kwargs": {...}}`` — the browser-facing call
+        path. Same auth + context injection as the websocket plane."""
+        try:
+            caller = self._http_caller(request)
+        except PermissionError as e:
+            return web.json_response({"error": str(e)}, status=401)
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except ValueError:
+            body = None
+        if not isinstance(body, dict):
+            return web.json_response({"error": "invalid JSON body"}, status=400)
+        service_id = request.match_info["service_id"]
+        method = request.match_info["method"]
+        # resolve first so only a wrong service/method is a 404 — an app
+        # bug raising KeyError inside the call must surface as a 500
+        try:
+            entry = self._find_service(service_id)
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        if entry.owner_client is None and method not in entry.methods:
+            return web.json_response(
+                {"error": f"{service_id} has no method '{method}'"}, status=404
+            )
+        try:
+            result = await self.call_service_method(
+                entry.full_id,
+                method,
+                tuple(body.get("args", ())),
+                body.get("kwargs", {}),
+                caller=caller,
+            )
+            return web.json_response({"result": _to_jsonable(result)})
+        except PermissionError as e:
+            return web.json_response({"error": str(e)}, status=403)
+        except Exception as e:
+            return web.json_response(
+                {"error": f"{type(e).__name__}: {e}"}, status=500
+            )
 
     async def _handle_ws(self, request: web.Request) -> web.WebSocketResponse:
         token = request.query.get("token", "")

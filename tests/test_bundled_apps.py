@@ -586,3 +586,106 @@ class TestTpuTest:
 
         mem = await call(server, sid, "memory_info")
         assert len(mem["devices"]) == 8
+
+
+class TestCellposeFrontend:
+    """Browser-frontend e2e: the static page is served through the
+    framework and its fetch endpoints (the JSON HTTP bridge) drive a
+    full session lifecycle — parity target ref
+    apps/cellpose-finetuning/frontend/index.html:1-1967."""
+
+    async def test_static_page_served(self, cellpose_app):
+        import aiohttp
+
+        result, server = cellpose_app
+        base = f"http://{server.host}:{server.port}"
+        async with aiohttp.ClientSession() as http:
+            async with http.get(f"{base}/apps/{result['app_id']}/") as r:
+                assert r.status == 200
+                text = await r.text()
+            assert "Cellpose Fine-Tuning" in text
+            # the page derives the service id from its own URL
+            assert "/apps/" in text and "/call/" in text
+            # path escape is rejected
+            async with http.get(
+                f"{base}/apps/{result['app_id']}/..%2f..%2fmanifest.yaml"
+            ) as r:
+                assert r.status in (403, 404)
+
+    async def test_frontend_url_in_deploy_and_status(self, cellpose_app):
+        result, server = cellpose_app
+        assert result["frontend_url"] == f"/apps/{result['app_id']}/"
+
+    async def test_fetch_endpoints_full_lifecycle(self, cellpose_app):
+        import aiohttp
+
+        result, server = cellpose_app
+        app_id = result["app_id"]
+        base = f"http://{server.host}:{server.port}"
+        images, masks = _synthetic_cells()
+        # what the browser sends: nested JSON lists from canvas pixels
+        images_json = [img.tolist() for img in images]
+        masks_json = [m.tolist() for m in masks]
+
+        async def post(method, **kwargs):
+            async with http.post(
+                f"{base}/call/{app_id}/{method}", json={"kwargs": kwargs}
+            ) as r:
+                data = await r.json()
+                assert r.status == 200, data
+                return data["result"]
+
+        async with aiohttp.ClientSession() as http:
+            cfg = await post("get_default_config")
+            assert "epochs" in cfg
+
+            started = await post(
+                "start_training",
+                train_images=images_json,
+                train_labels=masks_json,
+                config=FAST_CFG,
+                session_id="frontend-run",
+            )
+            assert started["status"] == "started"
+
+            deadline = time.time() + 120
+            while True:
+                status = await post(
+                    "get_training_status", session_id="frontend-run"
+                )
+                if status["status"] in ("completed", "failed"):
+                    break
+                assert time.time() < deadline, status
+                await asyncio.sleep(0.2)
+            assert status["status"] == "completed", status.get("error")
+            assert len(status["losses"]) == FAST_CFG["epochs"]
+
+            sessions = await post("list_sessions")
+            assert sessions[0]["session_id"] == "frontend-run"
+
+            out = await post(
+                "infer", session_id="frontend-run", images=images_json[:1]
+            )
+            # JSON bridge converts the numpy masks to nested lists
+            assert isinstance(out["masks"][0], list)
+            assert len(out["masks"][0]) == 64
+            assert out["n_cells"][0] >= 0
+
+            exported = await post("export_model", session_id="frontend-run")
+            assert Path(exported["model_path"]).joinpath("rdf.yaml").exists()
+
+    async def test_http_bridge_auth_errors(self, stack):
+        """Bad token -> 401; unknown service -> 404."""
+        import aiohttp
+
+        _, _, server, _ = stack
+        base = f"http://{server.host}:{server.port}"
+        async with aiohttp.ClientSession() as http:
+            async with http.post(
+                f"{base}/call/nope/ping",
+                json={},
+                headers={"Authorization": "Bearer bogus"},
+            ) as r:
+                assert r.status == 401
+            async with http.post(f"{base}/call/nope/ping", json={}) as r:
+                assert r.status == 404
