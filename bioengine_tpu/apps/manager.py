@@ -73,6 +73,7 @@ class AppsManager:
         admin_users: Optional[list[str]] = None,
         can_scale_out: bool = False,
         max_auto_redeploys: int = 3,
+        state_file: Optional[str | Path] = None,
         log_file: Optional[str] = None,
     ):
         self.controller = controller
@@ -84,9 +85,82 @@ class AppsManager:
         self.admin_users = list(admin_users or [])
         self.can_scale_out = can_scale_out
         self.max_auto_redeploys = max_auto_redeploys
+        self.state_file = Path(state_file) if state_file else None
         self.records: dict[str, AppRecord] = {}
         self.logger = create_logger("apps.manager", log_file=log_file)
         self._deploy_lock = asyncio.Lock()
+
+    # ---- record persistence + restart recovery -------------------------------
+
+    def _save_records(self) -> None:
+        """Persist every deploy's reproducible arguments so a restarted
+        worker can re-adopt its apps (ref bioengine/apps/manager.py:
+        841-935 recovers running Serve apps after a worker crash; here
+        recovery is redeploy-from-record, since replicas die with the
+        worker process)."""
+        if self.state_file is None:
+            return
+        payload = [
+            {
+                "app_id": r.app_id,
+                "artifact_id": r.artifact_id,
+                "version": r.version,
+                "local_path": r.local_path,
+                "deployment_kwargs": r.deployment_kwargs,
+                "env_vars": r.env_vars,
+                "authorized_users": r.authorized_users,
+                "auto_redeploy": r.auto_redeploy,
+                "deployed_by": r.deployed_by,
+                "deployed_at": r.deployed_at,
+            }
+            for r in self.records.values()
+        ]
+        import json
+
+        self.state_file.parent.mkdir(parents=True, exist_ok=True)
+        tmp = self.state_file.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(payload, indent=2))
+        tmp.rename(self.state_file)
+
+    async def recover_deployed_applications(self) -> list[dict]:
+        """Redeploy every app recorded by a previous worker life. Never
+        raises — a single bad record must not block worker startup."""
+        if self.state_file is None or not self.state_file.exists():
+            return []
+        import json
+
+        try:
+            saved = json.loads(self.state_file.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            self.logger.error(f"unreadable app state file: {e}")
+            return []
+        admin_ctx = create_context(
+            self.admin_users[0] if self.admin_users else "system",
+            workspace="bioengine",
+        )
+        results = []
+        for rec in saved:
+            app_id = rec.get("app_id")
+            if app_id in self.records:
+                continue
+            try:
+                results.append(
+                    await self.deploy_app(
+                        artifact_id=rec.get("artifact_id"),
+                        version=rec.get("version"),
+                        local_path=rec.get("local_path"),
+                        app_id=app_id,
+                        deployment_kwargs=rec.get("deployment_kwargs"),
+                        env_vars=rec.get("env_vars"),
+                        authorized_users=rec.get("authorized_users"),
+                        auto_redeploy=rec.get("auto_redeploy", False),
+                        context=admin_ctx,
+                    )
+                )
+                self.logger.info(f"recovered app '{app_id}'")
+            except Exception as e:
+                self.logger.error(f"recovery of '{app_id}' failed: {e}")
+        return results
 
     # ---- id generation ------------------------------------------------------
 
@@ -175,6 +249,7 @@ class AppsManager:
                 env_vars=dict(env_vars or {}),
                 frontend_url=frontend_url,
             )
+            self._save_records()
             self.logger.info(
                 f"deployed '{app_id}' ({built.manifest.name}) "
                 f"by {deployer}"
@@ -211,6 +286,7 @@ class AppsManager:
             unregister(app_id)
         record.proxy.deregister()
         await self.controller.undeploy(app_id)
+        self._save_records()
 
     async def stop_app(self, app_id: str, context: Optional[dict] = None) -> dict:
         check_permissions(context, self.admin_users, "stop_app")
@@ -220,12 +296,24 @@ class AppsManager:
             await self._undeploy(app_id)
         return {"app_id": app_id, "status": "STOPPED"}
 
-    async def stop_all_apps(self, context: Optional[dict] = None) -> list[str]:
+    async def stop_all_apps(
+        self, context: Optional[dict] = None, forget: bool = True
+    ) -> list[str]:
+        """``forget=False`` (worker shutdown) keeps the persisted records
+        so the next worker life re-adopts the apps; ``forget=True`` (an
+        admin explicitly clearing the cluster) erases them."""
         check_permissions(context, self.admin_users, "stop_all_apps")
         async with self._deploy_lock:
+            keep = (
+                self.state_file.read_text()
+                if not forget and self.state_file and self.state_file.exists()
+                else None
+            )
             stopped = list(self.records)
             for app_id in stopped:
                 await self._undeploy(app_id)
+            if keep is not None:
+                self.state_file.write_text(keep)
         return stopped
 
     # ---- status -------------------------------------------------------------

@@ -129,6 +129,7 @@ class BioEngineWorker:
             builder=builder,
             admin_users=self.admin_users,
             can_scale_out=self.cluster.mode in ("slurm", "gke"),
+            state_file=self.workspace_dir / "apps" / "deployed.json",
             log_file=self.log_file,
         )
 
@@ -150,6 +151,14 @@ class BioEngineWorker:
         if self.server_url:
             await self._connect_remote()
 
+        # re-adopt apps recorded by a previous worker life (ref
+        # bioengine/apps/manager.py:841-935), then the configured
+        # startup apps (already-recovered ids are skipped by record)
+        recovered = await self.apps_manager.recover_deployed_applications()
+        if recovered:
+            self.logger.info(
+                f"recovered {len(recovered)} app(s) from previous run"
+            )
         if self.startup_applications:
             await self.apps_manager.deploy_startup_applications(
                 self.startup_applications
@@ -187,7 +196,11 @@ class BioEngineWorker:
                     admin_ctx = create_context(
                         self.admin_users[0], workspace="bioengine"
                     )
-                    await self.apps_manager.stop_all_apps(context=admin_ctx)
+                    # forget=False: a graceful shutdown keeps the
+                    # persisted records so restart re-adopts the apps
+                    await self.apps_manager.stop_all_apps(
+                        context=admin_ctx, forget=False
+                    )
                 except Exception as e:
                     self.logger.warning(f"stopping apps failed: {e}")
             if self.controller:
