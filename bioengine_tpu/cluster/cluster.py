@@ -154,18 +154,33 @@ class TpuCluster:
         return actions
 
     def _idle_worker_ids(self) -> set[str]:
-        """Workers considered idle: no LIVE replica anywhere in the
-        cluster (dead ReplicaRecords are history, not load)."""
+        """Workers eligible for scale-down.
+
+        Per-host idleness: a joined host with no live replica leased to
+        it maps back to its backend job through the ``worker_tag`` it
+        reported on join (the reference correlates idle Ray nodes to
+        SLURM jobs the same way, ref slurm_workers.py:817-903). Workers
+        whose host never joined stay un-cancellable here — the
+        provisioner's own state polling reaps jobs that died before
+        joining."""
         if self.state is None:
             return set()
-        live = [r for r in self.state.replicas() if r.alive]
-        if live:
-            return set()
-        return {
-            w.worker_id
+        live_hosts = {
+            r.host_id for r in self.state.replicas() if r.alive
+        }  # may contain None = the controller host itself
+        tag_to_worker = {
+            w.worker_tag: w.worker_id
             for w in self.provisioner.active_workers()
-            if w.state == "running"
+            if w.worker_tag
         }
+        idle = set()
+        for host in self.state.hosts.values():
+            if not host.alive or host.host_id in live_hosts:
+                continue
+            worker_id = tag_to_worker.get(host.worker_tag)
+            if worker_id:
+                idle.add(worker_id)
+        return idle
 
     @property
     def status(self) -> dict:

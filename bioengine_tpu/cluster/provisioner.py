@@ -37,6 +37,12 @@ class WorkerRecord:
     submitted_at: float
     resources: dict[str, float]
     state: str = "pending"          # pending | running | draining | gone
+    # the tag the launched worker_host reports on join (--worker-tag);
+    # ClusterState.HostRecord.worker_tag carries it back, so an idle
+    # JOINED host can be mapped to the backend job to cancel (the
+    # reference correlates via a slurm_job_id custom Ray resource,
+    # ref slurm_workers.py:645-664)
+    worker_tag: Optional[str] = None
 
 
 @dataclass
@@ -76,8 +82,9 @@ class Provisioner(abc.ABC):
     # -- backend verbs --------------------------------------------------------
 
     @abc.abstractmethod
-    def _submit(self, resources: dict[str, float]) -> str:
-        """Start one worker; return a backend job id."""
+    def _submit(self, resources: dict[str, float], worker_tag: str) -> str:
+        """Start one worker carrying ``worker_tag``; return a backend
+        job id."""
 
     @abc.abstractmethod
     def _cancel(self, backend_job_id: str) -> None: ...
@@ -111,12 +118,14 @@ class Provisioner(abc.ABC):
             req = getattr(item, "resources", None) or {}
             resources.update({k: v for k, v in req.items() if v})
             worker_id = f"worker-{uuid.uuid4().hex[:8]}"
-            job_id = self._submit(resources)
+            worker_tag = worker_id.removeprefix("worker-")
+            job_id = self._submit(resources, worker_tag)
             self.workers[worker_id] = WorkerRecord(
                 worker_id=worker_id,
                 backend_job_id=job_id,
                 submitted_at=time.time(),
                 resources=resources,
+                worker_tag=worker_tag,
             )
             self._last_scale_up = time.time()
             up.append(worker_id)
@@ -163,7 +172,7 @@ class Provisioner(abc.ABC):
 class NullProvisioner(Provisioner):
     """single-machine / external-cluster modes: capacity is fixed."""
 
-    def _submit(self, resources):  # pragma: no cover - never called
+    def _submit(self, resources, worker_tag):  # pragma: no cover - never called
         raise RuntimeError("NullProvisioner cannot scale")
 
     def _cancel(self, backend_job_id):
@@ -235,11 +244,10 @@ class SlurmProvisioner(Provisioner):
             ]
         )
 
-    def _submit(self, resources: dict[str, float]) -> str:
+    def _submit(self, resources: dict[str, float], worker_tag: str) -> str:
         import tempfile
 
-        tag = uuid.uuid4().hex[:8]
-        script = self.build_sbatch_script(resources, tag)
+        script = self.build_sbatch_script(resources, worker_tag)
         with tempfile.NamedTemporaryFile(
             "w", suffix=".sbatch", prefix="bioengine-", delete=False
         ) as f:
@@ -280,6 +288,7 @@ class GkeProvisioner(Provisioner):
         zone: str,
         accelerator_type: str = "v5litepod-8",
         runtime_version: str = "v2-alpha-tpuv5-lite",
+        worker_command: str = "python -m bioengine_tpu.worker_host",
         policy: Optional[ScalingPolicy] = None,
         runner: CommandRunner = _real_runner,
     ):
@@ -288,10 +297,29 @@ class GkeProvisioner(Provisioner):
         self.zone = zone
         self.accelerator_type = accelerator_type
         self.runtime_version = runtime_version
+        self.worker_command = worker_command
         self.runner = runner
 
-    def _submit(self, resources: dict[str, float]) -> str:
-        name = f"bioengine-{uuid.uuid4().hex[:8]}"
+    def build_startup_script(self, worker_tag: str) -> str:
+        """What the TPU VM runs on boot: join THIS control plane as a
+        worker host, tagged for targeted scale-down. Without this the
+        provisioned node would sit idle forever — the GKE analog of the
+        sbatch script's join env (ref slurm_workers.py:153-296)."""
+        lines = ["#!/bin/bash", "set -euo pipefail"]
+        if self.join_server_url:
+            lines.append(
+                f"export BIOENGINE_SERVER_URL={self.join_server_url}"
+            )
+        if self.join_token:
+            lines.append(f"export BIOENGINE_ADMIN_TOKEN={self.join_token}")
+        lines.append(
+            f"exec {self.worker_command} --worker-tag {worker_tag}"
+        )
+        return "\n".join(lines)
+
+    def _submit(self, resources: dict[str, float], worker_tag: str) -> str:
+        name = f"bioengine-{worker_tag}"
+        startup = self.build_startup_script(worker_tag)
         proc = self.runner(
             [
                 "gcloud", "compute", "tpus", "queued-resources", "create",
@@ -301,6 +329,7 @@ class GkeProvisioner(Provisioner):
                 f"--accelerator-type={self.accelerator_type}",
                 f"--runtime-version={self.runtime_version}",
                 f"--node-id={name}",
+                f"--metadata=startup-script={startup}",
             ]
         )
         if proc.returncode != 0:
