@@ -1,0 +1,35 @@
+# BioEngine-TPU datasets server image — serves zarr/file datasets over
+# HTTP with Range support (the analog of ref docker/datasets.Dockerfile,
+# which ships a FastAPI server; here the server is the framework's own
+# aiohttp app, bioengine_tpu/datasets/proxy_server.py).
+#
+#   docker build -f docker/datasets.Dockerfile -t bioengine-tpu-datasets .
+#
+# The zarr codecs bind SYSTEM libblosc/zstd/lz4 via ctypes
+# (bioengine_tpu/datasets/codecs.py) — no compiled Python wheels needed.
+
+FROM python:3.11-slim
+
+ENV PYTHONUNBUFFERED=1 \
+    PYTHONDONTWRITEBYTECODE=1 \
+    PIP_NO_CACHE_DIR=1
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    libblosc1 \
+    libzstd1 \
+    liblz4-1 \
+    curl \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+
+COPY docker/requirements-datasets.txt /app/
+RUN pip install -U pip && pip install -r requirements-datasets.txt
+
+COPY bioengine_tpu/ /app/bioengine_tpu/
+COPY pyproject.toml README.md /app/
+RUN pip install --no-deps .
+
+EXPOSE 39527
+
+CMD ["python", "-m", "bioengine_tpu.datasets", "/data", "--port", "39527"]

@@ -1,0 +1,99 @@
+#!/bin/bash
+# Start a BioEngine-TPU worker inside Apptainer/Singularity on an HPC
+# system — the TPU-native counterpart of the reference's
+# scripts/start_hpc_worker.sh (ref :1-306, which launches the Ray-based
+# GPU worker). All arguments are passed through to
+# `python -m bioengine_tpu.worker`; the script only resolves the
+# container runtime + image and sets up the bind mounts the worker
+# needs (workspace, datasets, TPU device nodes when present).
+#
+# Usage:
+#   ./scripts/start_hpc_worker.sh --mode slurm --workspace-dir ~/.bioengine \
+#       --datasets-dir /proj/data [worker args...]
+#
+# Environment:
+#   BIOENGINE_IMAGE      image URI or SIF path
+#                        (default: docker://ghcr.io/bioengine-tpu/worker:latest)
+#   BIOENGINE_SIF_CACHE  where to keep the built SIF (default: ~/.bioengine/sif)
+#   BIOENGINE_DRY_RUN=1  print the final command instead of exec'ing it
+
+set -euo pipefail
+
+WORKER_ARGS=("$@")
+
+# --- container runtime -------------------------------------------------------
+if command -v apptainer &>/dev/null; then
+    CONTAINER_CMD="apptainer"
+elif command -v singularity &>/dev/null; then
+    CONTAINER_CMD="singularity"
+else
+    echo "❌ Neither Apptainer nor Singularity found on PATH." >&2
+    exit 1
+fi
+
+# --- helpers -----------------------------------------------------------------
+get_arg_value() {
+    # get_arg_value --flag default -> value of "--flag VALUE" or "--flag=VALUE"
+    local tag="$1" value="$2"
+    local i
+    for ((i = 0; i < ${#WORKER_ARGS[@]}; i++)); do
+        if [[ "${WORKER_ARGS[i]}" == "$tag" ]] && ((i + 1 < ${#WORKER_ARGS[@]})); then
+            value="${WORKER_ARGS[i + 1]}"
+            break
+        elif [[ "${WORKER_ARGS[i]}" == "$tag="* ]]; then
+            value="${WORKER_ARGS[i]#*=}"
+            break
+        fi
+    done
+    echo "$value"
+}
+
+# --- image resolution --------------------------------------------------------
+IMAGE="${BIOENGINE_IMAGE:-docker://ghcr.io/bioengine-tpu/worker:latest}"
+SIF_CACHE="${BIOENGINE_SIF_CACHE:-$HOME/.bioengine/sif}"
+
+if [[ "$IMAGE" == docker://* ]]; then
+    mkdir -p "$SIF_CACHE"
+    SIF_NAME="$(echo "${IMAGE#docker://}" | tr '/:' '__').sif"
+    SIF_PATH="$SIF_CACHE/$SIF_NAME"
+    if [[ ! -f "$SIF_PATH" && "${BIOENGINE_DRY_RUN:-0}" != "1" ]]; then
+        echo "Building SIF from $IMAGE (one-time, cached at $SIF_PATH)..."
+        "$CONTAINER_CMD" pull "$SIF_PATH" "$IMAGE"
+    fi
+    IMAGE="$SIF_PATH"
+fi
+
+# --- bind mounts -------------------------------------------------------------
+WORKSPACE_DIR="$(get_arg_value --workspace-dir "$HOME/.bioengine")"
+WORKSPACE_DIR="${WORKSPACE_DIR/#\~/$HOME}"
+mkdir -p "$WORKSPACE_DIR"
+BINDS=(--bind "$WORKSPACE_DIR:$WORKSPACE_DIR")
+
+DATASETS_DIR="$(get_arg_value --datasets-dir "")"
+if [[ -n "$DATASETS_DIR" ]]; then
+    BINDS+=(--bind "$DATASETS_DIR:$DATASETS_DIR:ro")
+fi
+
+# TPU VM device nodes (present on Cloud TPU hosts; harmless to skip on
+# CPU-only login nodes where the worker runs control-plane only)
+for dev in /dev/accel* /dev/vfio; do
+    if [[ -e "$dev" ]]; then
+        BINDS+=(--bind "$dev:$dev")
+    fi
+done
+
+# --- launch ------------------------------------------------------------------
+CMD=("$CONTAINER_CMD" exec --cleanenv
+    --env "BIOENGINE_ADMIN_TOKEN=${BIOENGINE_ADMIN_TOKEN:-}"
+    --env "HOME=$HOME"
+    "${BINDS[@]}"
+    "$IMAGE"
+    python -m bioengine_tpu.worker "${WORKER_ARGS[@]}")
+
+if [[ "${BIOENGINE_DRY_RUN:-0}" == "1" ]]; then
+    printf '%q ' "${CMD[@]}"
+    printf '\n'
+    exit 0
+fi
+
+exec "${CMD[@]}"
