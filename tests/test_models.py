@@ -62,3 +62,29 @@ def test_cellpose_loss_components():
     loss, parts = cellpose_loss(pred, flows, cellprob)
     assert float(loss) > 0
     assert set(parts) == {"flow_loss", "bce_loss"}
+
+
+def test_vit_bf16_softmax_matches_f32():
+    """The perf default (bf16 softmax, bench.py/embedder) must stay
+    faithful to the f32 reference: cosine >= 0.999 per embedding."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bioengine_tpu.models.vit import ViT
+
+    fast = ViT(patch_size=14, dim=128, depth=4, num_heads=4)
+    exact = ViT(
+        patch_size=14, dim=128, depth=4, num_heads=4,
+        softmax_dtype=jnp.float32,
+    )
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(4, 56, 56, 3)).astype(np.float32)
+    )
+    params = fast.init(jax.random.key(1), x)["params"]
+    a = np.asarray(fast.apply({"params": params}, x))
+    b = np.asarray(exact.apply({"params": params}, x))
+    cos = (a * b).sum(-1) / (
+        np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1)
+    )
+    assert (cos >= 0.999).all(), cos
