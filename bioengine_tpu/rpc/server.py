@@ -98,6 +98,7 @@ class RpcServer:
         self._runner: Optional[web.AppRunner] = None
         self._site: Optional[web.TCPSite] = None
         self._static_dirs: dict[str, Any] = {}  # name -> Path
+        self.artifact_service = None            # attach_artifact_service
 
     # ---- lifecycle ----------------------------------------------------------
 
@@ -114,6 +115,10 @@ class RpcServer:
         # dynamically registered app frontends (register_static_dir)
         app.router.add_get("/apps/{name}", self._handle_static)
         app.router.add_get("/apps/{name}/{rest:.*}", self._handle_static)
+        # artifact manager HTTP surface (attach_artifact_service)
+        app.router.add_route(
+            "*", "/artifacts{tail:.*}", self._handle_artifacts
+        )
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         self._site = web.TCPSite(self._runner, self.host, self.port)
@@ -131,6 +136,17 @@ class RpcServer:
     @property
     def url(self) -> str:
         return f"ws://{self.host}:{self.port}/ws"
+
+    @property
+    def http_url(self) -> str:
+        """Advertisable base URL: a wildcard bind resolves to this
+        machine's routable address, never 'http://0.0.0.0:...'."""
+        host = self.host
+        if host in ("0.0.0.0", "::"):
+            from bioengine_tpu.utils.network import get_internal_ip
+
+            host = get_internal_ip()
+        return f"http://{host}:{self.port}"
 
     # ---- tokens -------------------------------------------------------------
 
@@ -229,9 +245,21 @@ class RpcServer:
         caller: Optional[TokenInfo] = None,
         timeout: float = 300.0,
     ) -> Any:
-        """Route a call to an in-process or remote-client service."""
+        """Route a call to an in-process or remote-client service.
+
+        ``visibility: "protected"`` services (worker-host replica verbs,
+        internal control surfaces) accept only admin callers; the
+        in-process path (``caller=None`` — the controller itself) is
+        trusted. Public services do their own per-method enforcement."""
         kwargs = dict(kwargs or {})
         entry = self._find_service(full_id)
+        visibility = entry.definition.get("config", {}).get(
+            "visibility", "public"
+        )
+        if visibility == "protected" and caller is not None and not caller.is_admin:
+            raise PermissionError(
+                f"service '{full_id}' is protected (admin required)"
+            )
         require_context = entry.definition.get("config", {}).get(
             "require_context", False
         )
@@ -325,6 +353,16 @@ class RpcServer:
         if not target.is_file():
             raise web.HTTPNotFound()
         return web.FileResponse(target)
+
+    def attach_artifact_service(self, service) -> None:
+        """Serve an ArtifactHttpService at ``/artifacts`` (presigned
+        uploads, versioned fetch, static site — apps/artifact_http.py)."""
+        self.artifact_service = service
+
+    async def _handle_artifacts(self, request: web.Request) -> web.Response:
+        if self.artifact_service is None:
+            raise web.HTTPNotFound(reason="no artifact service attached")
+        return await self.artifact_service.handle(request)
 
     def _http_caller(self, request: web.Request) -> TokenInfo:
         token = request.query.get("token", "")

@@ -162,18 +162,29 @@ class ServeController:
                 "id": "serve-router",
                 "name": "Serving controller router",
                 "type": "bioengine-serve-router",
-                "config": {"require_context": True, "visibility": "protected"},
+                # public visibility: every method self-enforces
+                # (register/deregister_host require admin; route_call
+                # enforces the target app's per-method ACL above)
+                "config": {"require_context": True, "visibility": "public"},
                 "route_call": route_call,
                 "register_host": register_host,
                 "deregister_host": deregister_host,
             }
         )
 
-    async def _call_host(self, service_id: str, method: str, *args, **kwargs):
+    async def _call_host(
+        self,
+        service_id: str,
+        method: str,
+        *args,
+        rpc_timeout: Optional[float] = None,
+        **kwargs,
+    ):
         if self._rpc_server is None:
             raise RuntimeError("controller has no RPC server attached")
         return await self._rpc_server.call_service_method(
-            service_id, method, args, kwargs
+            service_id, method, args, kwargs,
+            **({"timeout": rpc_timeout} if rpc_timeout else {}),
         )
 
     # ---- lifecycle ----------------------------------------------------------

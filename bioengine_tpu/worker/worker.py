@@ -114,8 +114,20 @@ class BioEngineWorker:
         # processes can join and receive replica placements
         self.controller.attach_rpc(self.server, admin_users=self.admin_users)
         await self.controller.start()
+        # chip-aware code execution: lease from the live cluster state,
+        # dispatch to joined hosts through the controller's RPC plumbing
+        self.code_executor.cluster_state = self.cluster.state
+        self.code_executor.call_host = self.controller._call_host
 
         artifact_store = LocalArtifactStore(self.workspace_dir / "artifacts")
+        # artifact manager HTTP surface: presigned uploads + static site
+        # (the reference's Hypha artifact manager, served by the
+        # framework itself — apps/artifact_http.py)
+        from bioengine_tpu.apps.artifact_http import ArtifactHttpService
+
+        self.server.attach_artifact_service(
+            ArtifactHttpService(artifact_store, self.server, log_file=self.log_file)
+        )
         builder = AppBuilder(
             store=artifact_store,
             workdir_root=self.workspace_dir / "apps",

@@ -120,6 +120,7 @@ class WorkerHost:
                 "replica_call": self.replica_call,
                 "replica_health": self.replica_health,
                 "stop_replica": self.stop_replica,
+                "run_code": self.run_code,
                 "shutdown": self.shutdown,
             }
         )
@@ -249,6 +250,34 @@ class WorkerHost:
             "state": state.value,
             "last_error": replica.last_error,
         }
+
+    async def run_code(
+        self,
+        payload: bytes,
+        device_ids: Optional[list[int]] = None,
+        env_vars: Optional[dict] = None,
+        cwd: Optional[str] = None,
+        timeout: float = 180.0,
+    ) -> dict:
+        """Execute a controller-dispatched run_code payload on THIS
+        host's leased chips (the TPU analog of a Ray task landing on a
+        cluster node with per-call resources, ref
+        bioengine/worker/code_executor.py:469-487). The service is
+        ``visibility: protected`` so only admin callers reach it."""
+        from bioengine_tpu.worker.code_executor import (
+            chip_env,
+            run_payload_subprocess,
+        )
+
+        env = {
+            **os.environ,
+            "BIOENGINE_HOST_ID": self.host_id,
+            **chip_env(list(device_ids or [])),
+            **(env_vars or {}),
+        }
+        return await run_payload_subprocess(
+            bytes(payload), env, cwd, timeout
+        )
 
     async def stop_replica(self, replica_id: str) -> dict:
         replica = self.replicas.pop(replica_id, None)

@@ -321,3 +321,97 @@ async def test_no_host_no_chips_raises_and_enqueues_pending(control_plane, tmp_p
         await controller.deploy("chip-app2", built.specs)
     pending = controller.cluster_state.pending()
     assert any(p.workload_id == "chip-app2/chip_deployment" for p in pending)
+
+
+async def test_run_code_dispatches_to_host_with_chips(control_plane, tmp_path):
+    """Chip-requesting run_code lands on the joined worker host with a
+    leased chip set visible to the child process (ref
+    bioengine/worker/code_executor.py:469-487); chip-free run_code stays
+    local; unsatisfiable requests fail loudly (VERDICT r3 missing #8)."""
+    from bioengine_tpu.utils.permissions import create_context
+    from bioengine_tpu.worker.code_executor import CodeExecutor
+
+    server, controller, token = control_plane
+    executor = CodeExecutor(
+        admin_users=["admin"],
+        cluster_state=controller.cluster_state,
+        call_host=controller._call_host,
+    )
+    admin = create_context("admin")
+    code = (
+        "import os\n"
+        "def main():\n"
+        "    return {'host': os.environ.get('BIOENGINE_HOST_ID'),\n"
+        "            'chips': os.environ.get('BIOENGINE_LEASED_CHIPS')}\n"
+    )
+
+    # no chips requested: local subprocess, no host involved
+    local = await executor.run_code(code=code, context=admin)
+    assert local["status"] == "ok"
+    assert local["result"]["host"] is None
+
+    # chips requested but nothing anywhere: loud error, not silence
+    with pytest.raises(RuntimeError, match="no joined host"):
+        await executor.run_code(
+            code=code, remote_options={"num_chips": 2}, context=admin
+        )
+
+    host = _spawn_host(server.url, token, "hcode", tmp_path)
+    try:
+        await _wait_for_host(controller, "hcode")
+        result = await executor.run_code(
+            code=code, remote_options={"num_chips": 2}, context=admin
+        )
+        assert result["status"] == "ok", result
+        assert result["host_id"] == "hcode"
+        assert result["result"]["host"] == "hcode"
+        assert result["result"]["chips"] == "0,1"
+        assert result["device_ids"] == [0, 1]
+        # lease released after the run
+        hrec = controller.cluster_state.hosts["hcode"]
+        assert hrec.chips_in_use == {}
+
+        # more chips than the host has: loud error
+        with pytest.raises(RuntimeError, match="no joined host"):
+            await executor.run_code(
+                code=code, remote_options={"num_chips": 64}, context=admin
+            )
+    finally:
+        host.terminate()
+        host.wait(timeout=10)
+
+    # unknown remote_options are rejected, not dropped
+    with pytest.raises(ValueError, match="unsupported remote_options"):
+        await executor.run_code(
+            code=code, remote_options={"num_gpus": 1}, context=admin
+        )
+
+
+async def test_protected_host_service_rejects_non_admin(control_plane, tmp_path):
+    """Anonymous/non-admin clients must not reach worker-host verbs
+    (start_replica executes arbitrary payloads — admin only)."""
+    from bioengine_tpu.rpc.client import connect_to_server
+
+    server, controller, token = control_plane
+    host = _spawn_host(server.url, token, "hsec", tmp_path)
+    try:
+        await _wait_for_host(controller, "hsec")
+        svc_id = controller.cluster_state.hosts["hsec"].service_id
+        conn = await connect_to_server({"server_url": server.url})
+        try:
+            with pytest.raises(Exception, match="protected"):
+                await conn.call(svc_id, "describe")
+        finally:
+            await conn.disconnect()
+        # admin still passes
+        conn = await connect_to_server(
+            {"server_url": server.url, "token": token}
+        )
+        try:
+            desc = await conn.call(svc_id, "describe")
+            assert desc["host_id"] == "hsec"
+        finally:
+            await conn.disconnect()
+    finally:
+        host.terminate()
+        host.wait(timeout=10)
