@@ -68,7 +68,12 @@ class LocalArtifactStore:
     def get_file(
         self, artifact_id: str, path: str, version: Optional[str] = None
     ) -> bytes:
-        f = self.artifact_dir(artifact_id, version) / path
+        base = self.artifact_dir(artifact_id, version)
+        f = (base / path).resolve()
+        # defense in depth: paths can arrive from HTTP routes
+        # (apps/artifact_http.py) — never read outside the version dir
+        if not f.is_relative_to(base.resolve()):
+            raise FileNotFoundError(f"{artifact_id}: path escapes artifact")
         if not f.is_file():
             raise FileNotFoundError(f"{artifact_id}@{version or 'latest'}:{path}")
         return f.read_bytes()

@@ -212,7 +212,11 @@ class AppsManager:
             app_id = app_id or self._generate_app_id()
             deployer = (context or {}).get("user", {}).get("id", "unknown")
 
-            built = self.builder.build(
+            # build in a thread: it execs sources and (with a REMOTE
+            # artifact store) does blocking HTTP fetches that must not
+            # stall the event loop serving those very requests
+            built = await asyncio.to_thread(
+                self.builder.build,
                 app_id=app_id,
                 artifact_id=artifact_id,
                 version=version,
@@ -340,6 +344,15 @@ class AppsManager:
                 "deployed_at": record.deployed_at,
                 "service_id": record.proxy.service_id,
                 "frontend_url": record.frontend_url,
+                # public static-site URL when deployed from an artifact
+                # (ref utils/artifact_utils.py:612-628)
+                "artifact_view_url": (
+                    f"{self.server.http_url}/artifacts/{record.artifact_id}/view/"
+                    if record.artifact_id
+                    and getattr(self.server, "http_url", None)
+                    and getattr(self.server, "artifact_service", None)
+                    else None
+                ),
                 "available_methods": sorted(record.built.schema_methods),
                 "authorized_users": record.built.authorized_users,
                 # secret convention: only names, never values

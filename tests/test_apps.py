@@ -403,3 +403,188 @@ class TestAutoRedeployPreservesOverrides:
             await server.call_service_method(
                 record.proxy.service_id, "ping", caller=eve
             )
+
+
+class TestRemoteArtifacts:
+    """Remote artifact manager (VERDICT r3 missing #4): presigned-PUT
+    upload -> commit -> build/deploy from the remote store, static-site
+    URL, version rules over HTTP, auth on writes."""
+
+    @pytest.fixture
+    async def artifact_plane(self, tmp_path):
+        from bioengine_tpu.apps.artifact_http import (
+            ArtifactHttpService,
+            RemoteArtifactStore,
+        )
+        from bioengine_tpu.apps.artifacts import LocalArtifactStore
+        from bioengine_tpu.rpc.server import RpcServer
+
+        server = RpcServer(admin_users=["admin"])
+        await server.start()
+        token = server.issue_token("admin", is_admin=True)
+        backing = LocalArtifactStore(tmp_path / "store")
+        server.attach_artifact_service(ArtifactHttpService(backing, server))
+        remote = RemoteArtifactStore(server.http_url, token=token)
+        try:
+            yield server, remote, token
+        finally:
+            remote.close()
+            await server.stop()
+
+    APP_FILES = {
+        "manifest.yaml": (
+            "name: Remote Demo\n"
+            "id: remote-demo\n"
+            'id_emoji: "\\U0001F4E6"\n'
+            "description: uploaded over the presigned flow\n"
+            "type: tpu-serve\n"
+            "version: 1.0.0\n"
+            "deployments:\n"
+            "  - dep:Dep\n"
+            'authorized_users: ["*"]\n'
+        ),
+        "dep.py": (
+            "from bioengine_tpu.rpc import schema_method\n\n\n"
+            "class Dep:\n"
+            "    @schema_method\n"
+            "    async def ping(self, context=None):\n"
+            '        """Ping."""\n'
+            '        return {"pong": True}\n'
+        ),
+        "frontend/index.html": "<html><body>remote ui</body></html>",
+    }
+
+    async def test_upload_fetch_roundtrip(self, artifact_plane):
+        server, remote, _ = artifact_plane
+        aid, version = await asyncio.to_thread(
+            remote.put_files, dict(self.APP_FILES)
+        )
+        # every sync client call runs in a thread: the aiohttp server
+        # lives on THIS loop (in-process topology)
+        call = lambda fn, *a: asyncio.to_thread(fn, *a)
+        assert (aid, version) == ("remote-demo", "1.0.0")
+        assert await call(remote.list_artifacts) == ["remote-demo"]
+        assert await call(remote.latest_version, aid) == "1.0.0"
+        assert set(await call(remote.list_files, aid)) == set(self.APP_FILES)
+        assert (
+            await call(remote.get_file, aid, "dep.py")
+            == self.APP_FILES["dep.py"].encode()
+        )
+        manifest = await call(remote.get_manifest, aid)
+        assert manifest.name == "Remote Demo"
+
+    async def test_static_site_served(self, artifact_plane):
+        import aiohttp
+
+        server, remote, _ = artifact_plane
+        await asyncio.to_thread(remote.put_files, dict(self.APP_FILES))
+        async with aiohttp.ClientSession() as http:
+            async with http.get(
+                f"{server.http_url}/artifacts/remote-demo/view/frontend/index.html"
+            ) as r:
+                assert r.status == 200
+                assert "remote ui" in await r.text()
+                assert r.content_type == "text/html"
+
+    async def test_version_rules_over_http(self, artifact_plane):
+        from bioengine_tpu.apps.artifacts import ArtifactVersionError
+
+        _, remote, _ = artifact_plane
+        put = lambda v: asyncio.to_thread(
+            remote.put_files,
+            {**self.APP_FILES, "manifest.yaml":
+             self.APP_FILES["manifest.yaml"].replace("1.0.0", v)},
+            version=v,
+        )
+        await put("1.0.0")
+        await put("1.1.0")
+        latest = await asyncio.to_thread(remote.latest_version, "remote-demo")
+        assert latest == "1.1.0"
+        with pytest.raises(ArtifactVersionError):
+            await put("0.9.0")
+
+    async def test_writes_require_admin(self, artifact_plane):
+        import httpx
+
+        server, remote, _ = artifact_plane
+        from bioengine_tpu.apps.artifact_http import RemoteArtifactStore
+
+        anon = RemoteArtifactStore(server.http_url)  # no token
+        try:
+            with pytest.raises(httpx.HTTPStatusError):
+                await asyncio.to_thread(
+                    anon.put_files, dict(self.APP_FILES)
+                )
+        finally:
+            anon.close()
+        # bogus upload sig rejected
+        async def bad_put():
+            import aiohttp
+
+            async with aiohttp.ClientSession() as http:
+                async with http.put(
+                    f"{server.http_url}/artifacts/x/upload/evil.py?sig=nope",
+                    data=b"boom",
+                ) as r:
+                    return r.status
+        assert await bad_put() == 401
+
+    async def test_deploy_from_remote_store(self, artifact_plane, tmp_path):
+        """The full loop: upload over HTTP, then AppsManager backed by
+        the REMOTE store builds and serves the app."""
+        from bioengine_tpu.apps.builder import AppBuilder
+        from bioengine_tpu.apps.manager import AppsManager
+        from bioengine_tpu.cluster.state import ClusterState
+        from bioengine_tpu.serving.controller import ServeController
+
+        server, remote, _ = artifact_plane
+        await asyncio.to_thread(remote.put_files, dict(self.APP_FILES))
+
+        controller = ServeController(ClusterState(), health_check_period=3600)
+        builder = AppBuilder(
+            store=remote, workdir_root=tmp_path / "wd", admin_users=["admin"]
+        )
+        manager = AppsManager(
+            controller=controller, server=server, store=remote,
+            builder=builder, admin_users=["admin"],
+        )
+        result = await manager.deploy_app(
+            artifact_id="remote-demo", context=create_context("admin")
+        )
+        try:
+            out = await server.call_service_method(
+                f"bioengine/{result['app_id']}", "ping",
+                caller=server.validate_token(server.issue_token("u")),
+            )
+            assert out == {"pong": True}
+            status = manager.get_app_status(result["app_id"])
+            assert status["artifact_view_url"].endswith(
+                "/artifacts/remote-demo/view/"
+            )
+            # the frontend staged from the remote artifact is served
+            assert result["frontend_url"] == f"/apps/{result['app_id']}/"
+        finally:
+            await manager.stop_all_apps(context=create_context("admin"))
+            await controller.stop()
+
+    async def test_view_route_rejects_path_traversal(self, artifact_plane):
+        """Raw-socket request with dot segments (clients like curl
+        --path-as-is don't normalize) must not escape the artifact dir."""
+        import aiohttp
+
+        server, remote, _ = artifact_plane
+        await asyncio.to_thread(remote.put_files, dict(self.APP_FILES))
+        reader, writer = await asyncio.open_connection(
+            server.host, server.port
+        )
+        writer.write(
+            b"GET /artifacts/remote-demo/view/../../../../etc/hostname "
+            b"HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n"
+        )
+        await writer.drain()
+        raw = await reader.read(4096)
+        writer.close()
+        status = int(raw.split(b" ", 2)[1])
+        assert status in (400, 404), raw[:200]
+        # body is a JSON error, not file content
+        assert raw.split(b"\r\n\r\n", 1)[1].startswith(b'{"error"')
