@@ -344,6 +344,7 @@ class AppsManager:
                 "deployed_at": record.deployed_at,
                 "service_id": record.proxy.service_id,
                 "frontend_url": record.frontend_url,
+                "mcp_url": record.proxy.mcp_url,
                 # public static-site URL when deployed from an artifact
                 # (ref utils/artifact_utils.py:612-628)
                 "artifact_view_url": (

@@ -32,6 +32,7 @@ class AppServiceProxy:
         self.controller = controller
         self.built = built
         self.service_id: Optional[str] = None
+        self.mcp_url: Optional[str] = None
         self.logger = create_logger(f"proxy.{built.app_id}", log_file=log_file)
 
     @property
@@ -41,14 +42,26 @@ class AppServiceProxy:
         )
 
     def register(self) -> str:
-        """Register one proxy function per entry schema method."""
+        """Register one proxy function per entry schema method, plus the
+        app's MCP endpoint (ref proxy_deployment.py:834 registers an
+        MCP-type Hypha service; here the framework serves the protocol
+        itself at /mcp/{app_id} — apps/mcp.py)."""
         built = self.built
+        mcp_url = None
+        register_mcp = getattr(self.server, "register_mcp_app", None)
+        if register_mcp is not None:
+            mcp_url = register_mcp(built.app_id, self)
+        self.mcp_url = mcp_url
         definition: dict[str, Any] = {
             "id": built.app_id,
             "name": built.manifest.name,
             "type": "bioengine-app",
             "description": built.manifest.description,
-            "config": {"require_context": True, "visibility": "public"},
+            "config": {
+                "require_context": True,
+                "visibility": "public",
+                "mcp_url": mcp_url,
+            },
         }
         for method_name, schema in built.schema_methods.items():
             definition[method_name] = self._make_proxy_fn(method_name, schema)
@@ -60,11 +73,24 @@ class AppServiceProxy:
         self.logger.info(f"registered service {self.service_id}")
         return self.service_id
 
-    def _make_proxy_fn(self, method_name: str, schema: dict):
-        acl = self.built.authorized_users
+    async def call_method(
+        self, method_name: str, kwargs: dict, context: Optional[dict]
+    ) -> Any:
+        """ACL-checked call — the single enforcement point shared by the
+        websocket proxy functions and the MCP tools/call path."""
+        check_method_permission(
+            self.built.authorized_users, method_name, context
+        )
+        return await self.handle.call(method_name, **kwargs)
 
+    def _make_proxy_fn(self, method_name: str, schema: dict):
         async def proxy_fn(*args, context=None, **kwargs):
-            check_method_permission(acl, method_name, context)
+            if not args:
+                return await self.call_method(method_name, kwargs, context)
+            # positional calls can't ride the kwargs-only shared path
+            check_method_permission(
+                self.built.authorized_users, method_name, context
+            )
             return await self.handle.call(method_name, *args, **kwargs)
 
         proxy_fn.__name__ = method_name
@@ -75,6 +101,10 @@ class AppServiceProxy:
 
     def deregister(self) -> None:
         if self.service_id:
+            unregister_mcp = getattr(self.server, "unregister_mcp_app", None)
+            if unregister_mcp is not None:
+                unregister_mcp(self.built.app_id)
+            self.mcp_url = None
             self.server.unregister_service(self.service_id)
             self.logger.info(f"deregistered service {self.service_id}")
             self.service_id = None

@@ -99,6 +99,7 @@ class RpcServer:
         self._site: Optional[web.TCPSite] = None
         self._static_dirs: dict[str, Any] = {}  # name -> Path
         self.artifact_service = None            # attach_artifact_service
+        self._mcp_apps: dict[str, Any] = {}     # app_id -> AppServiceProxy
 
     # ---- lifecycle ----------------------------------------------------------
 
@@ -119,6 +120,8 @@ class RpcServer:
         app.router.add_route(
             "*", "/artifacts{tail:.*}", self._handle_artifacts
         )
+        # per-app MCP endpoints (register_mcp_app)
+        app.router.add_post("/mcp/{name}", self._handle_mcp)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         self._site = web.TCPSite(self._runner, self.host, self.port)
@@ -353,6 +356,47 @@ class RpcServer:
         if not target.is_file():
             raise web.HTTPNotFound()
         return web.FileResponse(target)
+
+    def register_mcp_app(self, app_id: str, proxy) -> str:
+        """Expose a deployed app as an MCP server at ``/mcp/{app_id}``
+        (streamable HTTP, apps/mcp.py). Returns the URL path."""
+        self._mcp_apps[app_id] = proxy
+        return f"/mcp/{app_id}"
+
+    def unregister_mcp_app(self, app_id: str) -> None:
+        self._mcp_apps.pop(app_id, None)
+
+    async def _handle_mcp(self, request: web.Request) -> web.Response:
+        from bioengine_tpu.apps.mcp import handle_message
+
+        proxy = self._mcp_apps.get(request.match_info["name"])
+        if proxy is None:
+            raise web.HTTPNotFound(
+                reason=f"no MCP app '{request.match_info['name']}'"
+            )
+        try:
+            caller = self._http_caller(request)
+        except PermissionError as e:
+            return web.json_response({"error": str(e)}, status=401)
+        try:
+            body = await request.json()
+        except ValueError:
+            body = None
+        if not isinstance(body, dict):
+            return web.json_response(
+                {
+                    "jsonrpc": "2.0",
+                    "id": None,
+                    "error": {"code": -32700, "message": "parse error"},
+                },
+                status=400,
+            )
+        response = await handle_message(
+            proxy, body, self._context_for(caller)
+        )
+        if response is None:  # notification
+            return web.Response(status=202)
+        return web.json_response(response)
 
     def attach_artifact_service(self, service) -> None:
         """Serve an ArtifactHttpService at ``/artifacts`` (presigned

@@ -588,3 +588,146 @@ class TestRemoteArtifacts:
         assert status in (400, 404), raw[:200]
         # body is a JSON error, not file content
         assert raw.split(b"\r\n\r\n", 1)[1].startswith(b'{"error"')
+
+
+class TestMcpEndpoint:
+    """Per-app MCP service parity (VERDICT r3 missing #5/#10): every
+    deployed app is an MCP server at /mcp/{app_id}; tools mirror the
+    schema methods and tools/call rides the same ACL as the proxy."""
+
+    @pytest.fixture
+    async def mcp_app(self, stack):
+        manager, controller, server, _ = stack
+        result = await manager.deploy_app(
+            local_path=str(REPO_APPS / "demo-app"),
+            context=create_context("admin"),
+        )
+        return result, server
+
+    async def _rpc(self, server, app_id, method, params=None, msg_id=1, token=None):
+        import aiohttp
+
+        headers = {"Authorization": f"Bearer {token}"} if token else {}
+        async with aiohttp.ClientSession() as http:
+            async with http.post(
+                f"http://{server.host}:{server.port}/mcp/{app_id}",
+                json={
+                    "jsonrpc": "2.0", "id": msg_id,
+                    "method": method, "params": params or {},
+                },
+                headers=headers,
+            ) as r:
+                if r.status == 202:
+                    return None
+                return await r.json()
+
+    async def test_initialize_and_tools_list(self, mcp_app):
+        result, server = mcp_app
+        app_id = result["app_id"]
+        init = await self._rpc(server, app_id, "initialize")
+        assert init["result"]["serverInfo"]["name"] == f"bioengine-{app_id}"
+        assert "tools" in init["result"]["capabilities"]
+        assert (
+            await self._rpc(server, app_id, "notifications/initialized")
+        ) is None
+        tools = await self._rpc(server, app_id, "tools/list")
+        names = {t["name"] for t in tools["result"]["tools"]}
+        assert {"ping", "echo"} <= names
+        echo = next(
+            t for t in tools["result"]["tools"] if t["name"] == "echo"
+        )
+        assert echo["inputSchema"]["type"] == "object"
+        assert "message" in echo["inputSchema"]["properties"]
+
+    async def test_tools_call_through_acl(self, mcp_app):
+        result, server = mcp_app
+        out = await self._rpc(
+            server, result["app_id"], "tools/call",
+            {"name": "echo", "arguments": {"message": "mcp!"}},
+        )
+        assert out["result"]["isError"] is False
+        import json as _json
+
+        payload = _json.loads(out["result"]["content"][0]["text"])
+        assert payload["echo"] == "mcp!"
+
+    async def test_tools_call_unknown_tool(self, mcp_app):
+        result, server = mcp_app
+        out = await self._rpc(
+            server, result["app_id"], "tools/call", {"name": "nope"}
+        )
+        assert out["error"]["code"] == -32602
+
+    async def test_locked_app_denies_anonymous_tool_call(self, stack):
+        manager, controller, server, _ = stack
+        result = await manager.deploy_app(
+            local_path=str(REPO_APPS / "demo-app"),
+            authorized_users=["alice"],
+            context=create_context("admin"),
+        )
+        out = await self._rpc(
+            server, result["app_id"], "tools/call",
+            {"name": "ping", "arguments": {}},
+        )
+        assert out["result"]["isError"] is True
+        assert "Permission denied" in out["result"]["content"][0]["text"]
+        # alice passes with her token
+        token = server.issue_token("alice")
+        ok = await self._rpc(
+            server, result["app_id"], "tools/call",
+            {"name": "ping", "arguments": {}}, token=token,
+        )
+        assert ok["result"]["isError"] is False
+
+    async def test_mcp_listed_in_service_and_status(self, mcp_app, stack):
+        manager, _, server, _ = stack
+        result, _srv = mcp_app
+        app_id = result["app_id"]
+        listing = next(
+            s for s in server.list_services()
+            if s["id"].endswith(f"/{app_id}")
+        )
+        assert listing["config"]["mcp_url"] == f"/mcp/{app_id}"
+        status = manager.get_app_status(app_id)
+        assert status["mcp_url"] == f"/mcp/{app_id}"
+        # undeploy removes the endpoint
+        import aiohttp
+
+        await manager.stop_app(app_id, context=create_context("admin"))
+        async with aiohttp.ClientSession() as http:
+            async with http.post(
+                f"http://{server.host}:{server.port}/mcp/{app_id}",
+                json={"jsonrpc": "2.0", "id": 1, "method": "initialize"},
+            ) as r:
+                assert r.status == 404
+
+    async def test_tools_call_strips_spoofed_context(self, mcp_app):
+        """'context' is server-injected everywhere; a caller-supplied
+        one via MCP arguments must never reach the app method."""
+        result, server = mcp_app
+        out = await self._rpc(
+            server, result["app_id"], "tools/call",
+            {
+                "name": "echo",
+                "arguments": {
+                    "message": "x",
+                    "context": {"user": {"id": "admin", "roles": ["admin"]}},
+                },
+            },
+        )
+        # the call succeeds (context stripped) rather than forwarding it
+        assert out["result"]["isError"] is False
+
+    async def test_non_object_body_is_parse_error(self, mcp_app):
+        import aiohttp
+
+        result, server = mcp_app
+        async with aiohttp.ClientSession() as http:
+            async with http.post(
+                f"http://{server.host}:{server.port}/mcp/{result['app_id']}",
+                data=b'"hello"',
+                headers={"Content-Type": "application/json"},
+            ) as r:
+                assert r.status == 400
+                body = await r.json()
+                assert body["error"]["code"] == -32700
