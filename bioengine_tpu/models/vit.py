@@ -38,11 +38,12 @@ class Attention(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     # Optional kernel override: fn(q, k, v) -> out, shapes (B, H, N, d).
     attn_fn: Optional[Callable] = None
-    # softmax accumulation dtype. bf16 keeps the N^2 tensors half-sized
-    # (measured +8-11% end-to-end on v5e at N=257) with embedding
-    # fidelity cosine >= 0.9999 vs f32 (tests/test_models.py); pass
-    # jnp.float32 for bit-conservative serving.
-    softmax_dtype: jnp.dtype = jnp.bfloat16
+    # softmax accumulation dtype; None = follow ``dtype``. In the bf16
+    # default this keeps the N^2 tensors half-sized (measured +8-11%
+    # end-to-end on v5e at N=257) with embedding fidelity cosine >=
+    # 0.9999 vs f32 (tests/test_models.py); pass jnp.float32 for
+    # bit-conservative serving at any compute dtype.
+    softmax_dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x):
@@ -58,8 +59,9 @@ class Attention(nn.Module):
             out = self.attn_fn(q, k, v)
         else:
             scale = head_dim**-0.5
+            sm_dtype = self.softmax_dtype or self.dtype
             logits = jnp.einsum("bhnd,bhmd->bhnm", q * scale, k)
-            weights = nn.softmax(logits.astype(self.softmax_dtype), axis=-1)
+            weights = nn.softmax(logits.astype(sm_dtype), axis=-1)
             out = jnp.einsum("bhnm,bhmd->bhnd", weights.astype(self.dtype), v)
         out = jnp.swapaxes(out, 1, 2).reshape(B, N, self.dim)
         return nn.Dense(self.dim, dtype=self.dtype, name="proj")(out)
@@ -71,7 +73,7 @@ class Block(nn.Module):
     mlp_ratio: float = 4.0
     dtype: jnp.dtype = jnp.bfloat16
     attn_fn: Optional[Callable] = None
-    softmax_dtype: jnp.dtype = jnp.bfloat16
+    softmax_dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x):
@@ -99,7 +101,7 @@ class ViT(nn.Module):
     mlp_ratio: float = 4.0
     dtype: jnp.dtype = jnp.bfloat16
     attn_fn: Optional[Callable] = None
-    softmax_dtype: jnp.dtype = jnp.bfloat16
+    softmax_dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, images):

@@ -1,3 +1,18 @@
 from bioengine_tpu.parallel.mesh import MeshSpec, make_mesh
+from bioengine_tpu.parallel.tensor_parallel import (
+    CONV_TP_RULES,
+    VIT_TP_RULES,
+    make_tp_apply,
+    shard_params,
+    tp_param_specs,
+)
 
-__all__ = ["MeshSpec", "make_mesh"]
+__all__ = [
+    "MeshSpec",
+    "make_mesh",
+    "CONV_TP_RULES",
+    "VIT_TP_RULES",
+    "make_tp_apply",
+    "shard_params",
+    "tp_param_specs",
+]

@@ -5,7 +5,8 @@ The framework's parallelism axes:
 - ``dp`` — data parallel (batch sharding; gradients all-reduced over ICI)
 - ``sp`` — spatial/sequence parallel (image tiles with halo exchange, or
   token-sequence shards for ring attention)
-- ``tp`` — tensor parallel (reserved; weight sharding for large models)
+- ``tp`` — tensor parallel (Megatron-style weight sharding,
+  parallel/tensor_parallel.py)
 
 The reference has no device-mesh concept at all — its unit of parallelism
 is a whole Ray Serve replica (ref apps/proxy_deployment.py:36-44). Here a
