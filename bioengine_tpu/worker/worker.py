@@ -287,6 +287,9 @@ class BioEngineWorker:
             "get_status": self.get_status,
             "get_logs": self.get_logs,
             "stop_worker": self._stop_worker_service,
+            "start_profiling": self.start_profiling,
+            "stop_profiling": self.stop_profiling,
+            "memory_profile": self.memory_profile,
             **self.code_executor.service_methods(),
         }
         assert self.apps_manager is not None
@@ -374,6 +377,65 @@ class BioEngineWorker:
         # apps: health-driven registration + auto-redeploy
         if self.apps_manager:
             await self.apps_manager.monitor_applications()
+
+    # ---- profiling (SURVEY §5.1: jax.profiler surface) ----------------------
+
+    def start_profiling(
+        self, trace_dir: Optional[str] = None, context: Optional[dict] = None
+    ) -> dict:
+        """Start a jax.profiler trace covering everything the worker's
+        process executes (serving replicas included — they run
+        in-process). Inspect with tensorboard/xprof. Admin-only."""
+        check_permissions(context, self.admin_users, "start_profiling")
+        import jax
+
+        if getattr(self, "_profile_dir", None):
+            raise RuntimeError(
+                f"profiling already active -> {self._profile_dir}"
+            )
+        trace_dir = trace_dir or str(
+            self.workspace_dir / "profiles" / time.strftime("%Y%m%d-%H%M%S")
+        )
+        Path(trace_dir).mkdir(parents=True, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        self._profile_dir = trace_dir
+        self.logger.info(f"profiling started -> {trace_dir}")
+        return {"trace_dir": trace_dir, "profiling": True}
+
+    def stop_profiling(self, context: Optional[dict] = None) -> dict:
+        check_permissions(context, self.admin_users, "stop_profiling")
+        import jax
+
+        trace_dir = getattr(self, "_profile_dir", None)
+        if not trace_dir:
+            raise RuntimeError("profiling is not active")
+        jax.profiler.stop_trace()
+        self._profile_dir = None
+        self.logger.info(f"profiling stopped -> {trace_dir}")
+        return {"trace_dir": trace_dir, "profiling": False}
+
+    def memory_profile(self, context: Optional[dict] = None) -> dict:
+        """Device-memory snapshot (pprof-format bytes, base64) plus the
+        cluster's live HBM telemetry — the on-demand analog of the
+        reference scraping GPU memory off the Ray dashboard (ref
+        cluster/proxy_actor.py:230-287)."""
+        check_permissions(context, self.admin_users, "memory_profile")
+        import base64 as b64
+
+        import jax
+
+        prof = jax.profiler.device_memory_profile()
+        return {
+            "pprof_b64": b64.b64encode(prof).decode(),
+            "devices": [
+                {
+                    "id": d.id,
+                    "kind": d.device_kind,
+                    "memory_stats": d.memory_stats() or {},
+                }
+                for d in jax.local_devices()
+            ],
+        }
 
     # ---- status / logs (ref worker.py:1034-1159) ----------------------------
 

@@ -372,3 +372,32 @@ async def test_worker_restart_after_explicit_stop_forgets_apps(tmp_path):
         assert "forget-me" not in w2.apps_manager.records
     finally:
         await w2.stop()
+
+
+async def test_worker_profiling_service(worker, tmp_path):
+    """jax.profiler surface (SURVEY §5.1): trace start/stop writes
+    artifacts; memory_profile returns pprof bytes + device stats."""
+    trace_dir = tmp_path / "trace"
+    with pytest.raises(PermissionError):
+        worker.start_profiling(context=ANON_CTX)
+    started = worker.start_profiling(
+        trace_dir=str(trace_dir), context=ADMIN_CTX
+    )
+    assert started["profiling"] is True
+    with pytest.raises(RuntimeError, match="already active"):
+        worker.start_profiling(context=ADMIN_CTX)
+    # do some device work so the trace has content
+    import jax.numpy as jnp
+
+    _ = float(jnp.ones((64, 64)).sum())
+    stopped = worker.stop_profiling(context=ADMIN_CTX)
+    assert stopped["trace_dir"] == str(trace_dir)
+    assert any(trace_dir.rglob("*")), "trace dir is empty"
+    with pytest.raises(RuntimeError, match="not active"):
+        worker.stop_profiling(context=ADMIN_CTX)
+
+    mem = worker.memory_profile(context=ADMIN_CTX)
+    import base64
+
+    assert len(base64.b64decode(mem["pprof_b64"])) > 0
+    assert mem["devices"]
