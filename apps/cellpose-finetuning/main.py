@@ -32,7 +32,15 @@ import yaml
 from bioengine_tpu.rpc import schema_method
 
 DEFAULT_CONFIG = {
-    "features": [32, 64, 128, 256],
+    # "unet" = CellposeNet (residual U-Net); "sam" = CellposeSAM, the
+    # transformer-backbone family member matching the reference's
+    # Cellpose-SAM fine-tuning target (models/cellpose_sam.py)
+    "backbone": "unet",
+    "features": [32, 64, 128, 256],      # unet backbone
+    "patch_size": 8,                      # sam backbone
+    "dim": 256,
+    "depth": 8,
+    "num_heads": 8,
     "learning_rate": 1e-4,
     "weight_decay": 1e-5,
     "epochs": 10,
@@ -40,6 +48,47 @@ DEFAULT_CONFIG = {
     "tile": 128,
     "seed": 0,
 }
+
+
+def build_model(cfg: dict):
+    """(model, divisor) for the configured backbone — both emit the same
+    (B, H, W, 3) flow/cellprob logits, so the train step, loss, flows
+    postprocessing, and export path are backbone-agnostic."""
+    if cfg.get("backbone", "unet") == "sam":
+        from bioengine_tpu.models.cellpose_sam import CellposeSAM
+
+        model = CellposeSAM(
+            patch_size=int(cfg.get("patch_size", 8)),
+            dim=int(cfg.get("dim", 256)),
+            depth=int(cfg.get("depth", 8)),
+            num_heads=int(cfg.get("num_heads", 8)),
+            in_channels=2,
+        )
+        return model, model.divisor
+    from bioengine_tpu.models.cellpose import CellposeNet
+
+    model = CellposeNet(features=tuple(cfg["features"]), in_channels=2)
+    return model, 2 ** (len(cfg["features"]) - 1)
+
+
+def _arch_entry(cfg: dict) -> dict:
+    """rdf.yaml architecture stanza for the configured backbone — the
+    registry name + kwargs the model-runner uses to rebuild it."""
+    if cfg.get("backbone", "unet") == "sam":
+        return {
+            "name": "cellpose-sam",
+            "kwargs": {
+                "patch_size": int(cfg.get("patch_size", 8)),
+                "dim": int(cfg.get("dim", 256)),
+                "depth": int(cfg.get("depth", 8)),
+                "num_heads": int(cfg.get("num_heads", 8)),
+                "in_channels": 2,
+            },
+        }
+    return {
+        "name": "cellpose",
+        "kwargs": {"features": list(cfg["features"]), "in_channels": 2},
+    }
 
 
 def _now() -> float:
@@ -203,9 +252,7 @@ class CellposeFinetune:
         import jax.numpy as jnp
         import optax
 
-        from bioengine_tpu.models.cellpose import (
-            CellposeNet, TrainState, make_train_step,
-        )
+        from bioengine_tpu.models.cellpose import TrainState, make_train_step
         from bioengine_tpu.parallel.data_parallel import (
             jit_data_parallel_step, replicate, shard_batch,
         )
@@ -216,14 +263,14 @@ class CellposeFinetune:
         data = np.load(session.data_dir / "train.npz")
         images, flows, cellprob = data["images"], data["flows"], data["cellprob"]
         n, H, W = images.shape[:3]
-        # tile must divide through the encoder's pools or the decoder's
-        # skip concatenations misalign
-        divisor = 2 ** (len(cfg["features"]) - 1)
+        model, divisor = build_model(cfg)
+        # tile must divide through the encoder (pool stages / patch
+        # grid) or the decoder output misaligns
         tile = min(cfg["tile"], H, W)
         if tile < divisor:
             raise ValueError(
                 f"images ({H}x{W}) smaller than the model's minimum tile "
-                f"{divisor} for features={cfg['features']}"
+                f"{divisor} for this backbone config"
             )
         tile = (tile // divisor) * divisor
 
@@ -235,7 +282,6 @@ class CellposeFinetune:
             dp *= 2
         mesh = make_mesh({"dp": dp}, jax.devices()[:dp])
 
-        model = CellposeNet(features=tuple(cfg["features"]), in_channels=2)
         rng = np.random.default_rng(cfg["seed"])
         start_epoch = 0
         restored_state = None
@@ -471,26 +517,30 @@ class CellposeFinetune:
     def _infer(self, session, images, cellprob_threshold, min_size):
         import jax
 
-        from bioengine_tpu.models.cellpose import CellposeNet
         from bioengine_tpu.ops.flows import predictions_to_masks
         from bioengine_tpu.runtime.buckets import bucket_shape, crop_to, pad_to
         from bioengine_tpu.runtime.convert import load_params_npz
 
         cfg = session.config
-        features = tuple(cfg["features"])
-        model = CellposeNet(features=features, in_channels=2)
+        model, divisor = build_model(cfg)
         # one jitted forward per architecture: params are an argument, so
         # per-epoch snapshots and repeated infer calls reuse the compiled
         # program instead of retracing a fresh lambda every request
-        if features not in self._fwd_cache:
-            self._fwd_cache[features] = jax.jit(
+        arch_key = (
+            cfg.get("backbone", "unet"),
+            tuple(cfg["features"]),
+            cfg.get("patch_size"), cfg.get("dim"),
+            cfg.get("depth"), cfg.get("num_heads"),
+        )
+        if arch_key not in self._fwd_cache:
+            self._fwd_cache[arch_key] = jax.jit(
                 lambda p, a, m=model: m.apply({"params": p}, a)
             )
-        fwd = self._fwd_cache[features]
+        fwd = self._fwd_cache[arch_key]
         params = load_params_npz(str(session.latest_path))
         x = self._prepare_images(images)
         H, W = x.shape[1:3]
-        bh, bw = bucket_shape((H, W), divisor=model.divisor)
+        bh, bw = bucket_shape((H, W), divisor=divisor)
         pred = np.asarray(fwd(params, pad_to(x, (bh, bw))))
         pred = crop_to(pred, (H, W))
         return [
@@ -532,13 +582,7 @@ class CellposeFinetune:
             "weights": {
                 "jax_params": {
                     "source": "weights.npz",
-                    "architecture": {
-                        "name": "cellpose",
-                        "kwargs": {
-                            "features": list(cfg["features"]),
-                            "in_channels": 2,
-                        },
-                    },
+                    "architecture": _arch_entry(cfg),
                 }
             },
             "training": {

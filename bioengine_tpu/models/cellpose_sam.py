@@ -1,0 +1,129 @@
+"""Transformer-backbone Cellpose — the Cellpose-SAM family analog.
+
+The reference fine-tunes *Cellpose-SAM*: a SAM-style ViT image encoder
+with a lightweight upsampling head predicting cellpose's 3-channel map
+(ref apps/cellpose-finetuning/main.py — its torch train loop wraps the
+cpsam torch model). This is the TPU-native member of that family:
+
+- patch embedding + transformer blocks reuse ``models/vit.py``'s
+  ``Block`` (bf16 matmuls on the MXU, optional ``attn_fn`` to route
+  long-sequence attention through the Pallas flash kernel or ring
+  attention when the token axis is sharded over ``sp``),
+- 2-D sin-cos positional embeddings computed from the token grid, so
+  ANY tile size divisible by ``patch_size`` works without interpolating
+  a learned table (fine-tuning tiles differ from inference tiles),
+- a progressive ConvTranspose decoder restores full resolution, with
+  the cellpose-style global style vector (mean token, L2-normalized)
+  modulating each stage,
+- same output contract as ``CellposeNet``: (B, H, W, 3) f32 logits
+  (flow_y, flow_x, cellprob), so ``cellpose_loss``, ``make_train_step``,
+  ``ops/flows`` postprocessing, data-parallel fine-tuning, and the
+  model-runner ``jax_params`` path all work unchanged.
+
+Select it in the cellpose-finetuning app with
+``config={"backbone": "sam", ...}``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from bioengine_tpu.models.vit import Block
+
+
+def sincos_pos_embed_2d(h: int, w: int, dim: int) -> jnp.ndarray:
+    """(h*w, dim) fixed 2-D sin-cos position embedding (half the
+    channels encode y, half x)."""
+    assert dim % 4 == 0, "pos embed dim must be divisible by 4"
+    quarter = dim // 4
+    omega = 1.0 / (10000.0 ** (jnp.arange(quarter, dtype=jnp.float32) / quarter))
+    ys = jnp.arange(h, dtype=jnp.float32)[:, None] * omega[None, :]  # (h, q)
+    xs = jnp.arange(w, dtype=jnp.float32)[:, None] * omega[None, :]  # (w, q)
+    y = jnp.concatenate([jnp.sin(ys), jnp.cos(ys)], axis=-1)  # (h, dim/2)
+    x = jnp.concatenate([jnp.sin(xs), jnp.cos(xs)], axis=-1)  # (w, dim/2)
+    grid = jnp.concatenate(
+        [
+            jnp.repeat(y[:, None, :], w, axis=1),
+            jnp.repeat(x[None, :, :], h, axis=0),
+        ],
+        axis=-1,
+    )  # (h, w, dim)
+    return grid.reshape(h * w, dim)
+
+
+class CellposeSAM(nn.Module):
+    """ViT-encoder cellpose: in (B, H, W, C) with H, W divisible by
+    ``patch_size``; out (B, H, W, 3) f32 logits."""
+
+    patch_size: int = 8
+    dim: int = 256
+    depth: int = 8
+    num_heads: int = 8
+    mlp_ratio: float = 4.0
+    in_channels: int = 2
+    dtype: jnp.dtype = jnp.bfloat16
+    attn_fn: Optional[Callable] = None
+    softmax_dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        B, H, W, _ = x.shape
+        p = self.patch_size
+        gh, gw = H // p, W // p
+        x = nn.Conv(
+            self.dim, (p, p), strides=(p, p), dtype=self.dtype,
+            name="patch_embed",
+        )(x.astype(self.dtype))
+        x = x.reshape(B, gh * gw, self.dim)
+        x = x + sincos_pos_embed_2d(gh, gw, self.dim).astype(self.dtype)[None]
+        for i in range(self.depth):
+            x = Block(
+                self.dim, self.num_heads, self.mlp_ratio, self.dtype,
+                self.attn_fn, self.softmax_dtype, name=f"block{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="norm")(x).astype(self.dtype)
+
+        # cellpose-style global style vector from the token field
+        style = jnp.mean(x, axis=1)
+        style = style / (
+            jnp.linalg.norm(style.astype(jnp.float32), axis=-1, keepdims=True)
+            + 1e-6
+        ).astype(self.dtype)
+
+        # tokens -> feature map -> progressive 2x decoder back to (H, W)
+        x = x.reshape(B, gh, gw, self.dim)
+        feats = self.dim
+        for stage in range(int(math.log2(p))):
+            feats = max(feats // 2, 32)
+            x = nn.ConvTranspose(
+                feats, (2, 2), strides=(2, 2), dtype=self.dtype,
+                name=f"up{stage}",
+            )(x)
+            x = nn.GroupNorm(
+                num_groups=min(32, feats), dtype=self.dtype,
+                name=f"up{stage}_norm",
+            )(x)
+            x = nn.silu(x)
+            bias = nn.Dense(feats, dtype=self.dtype, name=f"up{stage}_style")(
+                style
+            )
+            x = x + bias[:, None, None, :]
+            x = nn.Conv(
+                feats, (3, 3), padding="SAME", dtype=self.dtype,
+                name=f"up{stage}_conv",
+            )(x)
+            x = nn.silu(x)
+        x = nn.Conv(3, (1, 1), dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+    @property
+    def divisor(self) -> int:
+        # patch grid must tile the input; decoder restores exactly p x
+        assert self.patch_size & (self.patch_size - 1) == 0, (
+            "patch_size must be a power of two"
+        )
+        return self.patch_size

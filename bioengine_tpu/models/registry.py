@@ -50,6 +50,13 @@ def _cellpose(**kw) -> nn.Module:
     return CellposeNet(**kw)
 
 
+@register_model("cellpose-sam")
+def _cellpose_sam(**kw) -> nn.Module:
+    from bioengine_tpu.models.cellpose_sam import CellposeSAM
+
+    return CellposeSAM(**kw)
+
+
 @register_model("vit-b14")
 def _vit_b14(**kw) -> nn.Module:
     from bioengine_tpu.models.vit import ViT

@@ -689,3 +689,72 @@ class TestCellposeFrontend:
                 assert r.status == 401
             async with http.post(f"{base}/call/nope/ping", json={}) as r:
                 assert r.status == 404
+
+
+SAM_CFG = {
+    "backbone": "sam",
+    "patch_size": 4,
+    "dim": 64,
+    "depth": 2,
+    "num_heads": 4,
+    "epochs": 2,
+    "batch_size": 4,
+    "tile": 32,
+    "learning_rate": 1e-3,
+}
+
+
+class TestCellposeSamBackbone:
+    """The transformer backbone rides the whole session protocol: train,
+    resume, live inference, export as a servable cellpose-sam package."""
+
+    async def test_sam_session_train_infer_export(self, cellpose_app):
+        result, server = cellpose_app
+        sid = result["service_id"]
+        images, masks = _synthetic_cells()
+
+        started = await call(
+            server, sid, "start_training",
+            train_images=images, train_labels=masks, config=SAM_CFG,
+            session_id="sam-run",
+        )
+        assert started["status"] == "started"
+        final = await wait_for_status(
+            server, sid, "sam-run", {"completed", "failed"}
+        )
+        assert final["status"] == "completed", final.get("error")
+        assert final["losses"][-1] < final["losses"][0]
+
+        out = await call(
+            server, sid, "infer", session_id="sam-run", images=images[:1]
+        )
+        assert out["masks"][0].shape == (64, 64)
+
+        exported = await call(
+            server, sid, "export_model", session_id="sam-run",
+            model_name="sam-export",
+        )
+        import yaml as _yaml
+
+        rdf = _yaml.safe_load(
+            (Path(exported["model_path"]) / "rdf.yaml").read_text()
+        )
+        arch = rdf["weights"]["jax_params"]["architecture"]
+        assert arch["name"] == "cellpose-sam"
+        assert arch["kwargs"]["patch_size"] == 4
+
+        # the export is servable by the model-runner registry path
+        from bioengine_tpu.models import get_model
+        from bioengine_tpu.runtime.convert import load_params_npz
+
+        import jax
+
+        model = get_model(arch["name"], **arch["kwargs"])
+        params = load_params_npz(
+            str(Path(exported["model_path"]) / "weights.npz")
+        )
+        pred = model.apply(
+            {"params": params},
+            jax.numpy.zeros((1, 32, 32, 2), jax.numpy.float32),
+        )
+        assert pred.shape == (1, 32, 32, 3)
