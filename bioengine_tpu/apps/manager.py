@@ -345,6 +345,7 @@ class AppsManager:
                 "service_id": record.proxy.service_id,
                 "frontend_url": record.frontend_url,
                 "mcp_url": record.proxy.mcp_url,
+                "rtc_service_id": record.proxy.rtc_service_id,
                 # public static-site URL when deployed from an artifact
                 # (ref utils/artifact_utils.py:612-628)
                 "artifact_view_url": (

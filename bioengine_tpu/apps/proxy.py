@@ -33,6 +33,7 @@ class AppServiceProxy:
         self.built = built
         self.service_id: Optional[str] = None
         self.mcp_url: Optional[str] = None
+        self.rtc_service_id: Optional[str] = None
         self.logger = create_logger(f"proxy.{built.app_id}", log_file=log_file)
 
     @property
@@ -52,6 +53,11 @@ class AppServiceProxy:
         if register_mcp is not None:
             mcp_url = register_mcp(built.app_id, self)
         self.mcp_url = mcp_url
+        # WebRTC transport: registers only when aiortc is installed
+        # (apps/webrtc.py gate; ref proxy_deployment.py:599-732)
+        from bioengine_tpu.apps.webrtc import maybe_register_rtc
+
+        self.rtc_service_id = maybe_register_rtc(self.server, self)
         definition: dict[str, Any] = {
             "id": built.app_id,
             "name": built.manifest.name,
@@ -105,6 +111,9 @@ class AppServiceProxy:
             if unregister_mcp is not None:
                 unregister_mcp(self.built.app_id)
             self.mcp_url = None
+            if self.rtc_service_id:
+                self.server.unregister_service(self.rtc_service_id)
+                self.rtc_service_id = None
             self.server.unregister_service(self.service_id)
             self.logger.info(f"deregistered service {self.service_id}")
             self.service_id = None

@@ -731,3 +731,32 @@ class TestMcpEndpoint:
                 assert r.status == 400
                 body = await r.json()
                 assert body["error"]["code"] == -32700
+
+
+class TestWebRtcGate:
+    """WebRTC is gated on aiortc (not in the TPU image): registration
+    is skipped cleanly and every other plane keeps working."""
+
+    async def test_gate_off_without_aiortc(self, stack):
+        from bioengine_tpu.apps.webrtc import webrtc_available
+
+        manager, _, server, _ = stack
+        result = await manager.deploy_app(
+            local_path=str(REPO_APPS / "demo-app"),
+            context=create_context("admin"),
+        )
+        status = manager.get_app_status(result["app_id"])
+        if webrtc_available():  # pragma: no cover - image has no aiortc
+            assert status["rtc_service_id"]
+        else:
+            assert status["rtc_service_id"] is None
+            assert not [
+                s for s in server.list_services()
+                if s["type"] == "bioengine-app-rtc"
+            ]
+        # the app itself serves fine either way
+        out = await server.call_service_method(
+            f"bioengine/{result['app_id']}", "ping",
+            caller=server.validate_token(server.issue_token("u")),
+        )
+        assert out["pong"] is True
