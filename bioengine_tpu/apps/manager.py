@@ -205,6 +205,27 @@ class AppsManager:
         context: Optional[dict] = None,
     ) -> dict:
         check_permissions(context, self.admin_users, "deploy_app")
+        from bioengine_tpu.utils.tracing import span
+
+        with span("deploy_app", app_id=app_id, artifact_id=artifact_id):
+            return await self._deploy_app_inner(
+                artifact_id, version, local_path, app_id,
+                deployment_kwargs, env_vars, authorized_users,
+                auto_redeploy, context,
+            )
+
+    async def _deploy_app_inner(
+        self,
+        artifact_id,
+        version,
+        local_path,
+        app_id,
+        deployment_kwargs,
+        env_vars,
+        authorized_users,
+        auto_redeploy,
+        context,
+    ) -> dict:
         async with self._deploy_lock:
             is_update = app_id is not None and app_id in self.records
             if is_update:

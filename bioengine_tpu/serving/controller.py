@@ -239,6 +239,15 @@ class ServeController:
         """Place one replica: locally when this host has the chips, else
         on a joined worker host with capacity (RPC-backed RemoteReplica),
         else enqueue a pending workload for the provisioner."""
+        from bioengine_tpu.utils.tracing import span
+
+        with span(
+            "add_replica", app_id=app.app_id, deployment=spec.name,
+            chips=spec.chips_per_replica,
+        ):
+            return await self._add_replica_inner(app, spec)
+
+    async def _add_replica_inner(self, app: AppDeployment, spec: DeploymentSpec):
         replica = None
         host_id = None
         if spec.chips_per_replica > 0 and (

@@ -1,0 +1,80 @@
+"""Structured spans — lightweight control-plane tracing.
+
+Complements the jax.profiler surface (worker start/stop_profiling —
+device-side traces) with host-side spans over control-plane
+operations: deploys, replica starts, artifact commits, RPC dispatch.
+SURVEY §5.1's target: the reference has only log lines; spans give
+durations + outcome + nesting without any external collector.
+
+A process-wide ring buffer holds the most recent spans; the worker
+exposes them via ``get_traces``. Usage::
+
+    with span("deploy_app", app_id=app_id):
+        ...
+
+Nesting is tracked through a contextvar so children record their
+parent span id (async-safe).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Optional
+
+MAX_SPANS = 2048
+
+_spans: deque[dict] = deque(maxlen=MAX_SPANS)
+_lock = threading.Lock()
+_ids = itertools.count(1)
+_current: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
+    "bioengine_span", default=None
+)
+
+
+@contextmanager
+def span(name: str, **attrs: Any):
+    """Record one span; exceptions mark it failed and re-raise."""
+    span_id = next(_ids)
+    parent = _current.get()
+    token = _current.set(span_id)
+    started = time.time()
+    record = {
+        "span_id": span_id,
+        "parent_id": parent,
+        "name": name,
+        "attrs": attrs,
+        "started_at": started,
+    }
+    try:
+        yield record
+    except BaseException as e:
+        record["error"] = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        _current.reset(token)
+        record["duration_s"] = round(time.time() - started, 6)
+        with _lock:
+            _spans.append(record)
+
+
+def get_spans(
+    name: Optional[str] = None, max_spans: int = 200
+) -> list[dict]:
+    """Most recent spans, newest last; optionally filtered by name."""
+    with _lock:
+        items = list(_spans)
+    if name is not None:
+        items = [s for s in items if s["name"] == name]
+    return items[-max_spans:]
+
+
+def clear_spans() -> int:
+    with _lock:
+        n = len(_spans)
+        _spans.clear()
+    return n

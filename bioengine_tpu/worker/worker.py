@@ -290,6 +290,7 @@ class BioEngineWorker:
             "start_profiling": self.start_profiling,
             "stop_profiling": self.stop_profiling,
             "memory_profile": self.memory_profile,
+            "get_traces": self.get_traces,
             **self.code_executor.service_methods(),
         }
         assert self.apps_manager is not None
@@ -413,6 +414,19 @@ class BioEngineWorker:
         self._profile_dir = None
         self.logger.info(f"profiling stopped -> {trace_dir}")
         return {"trace_dir": trace_dir, "profiling": False}
+
+    def get_traces(
+        self,
+        name: Optional[str] = None,
+        max_spans: int = 200,
+        context: Optional[dict] = None,
+    ) -> list[dict]:
+        """Recent control-plane spans (deploys, replica placements —
+        utils/tracing.py), newest last. Admin-only."""
+        check_permissions(context, self.admin_users, "get_traces")
+        from bioengine_tpu.utils.tracing import get_spans
+
+        return get_spans(name=name, max_spans=max_spans)
 
     def memory_profile(self, context: Optional[dict] = None) -> dict:
         """Device-memory snapshot (pprof-format bytes, base64) plus the

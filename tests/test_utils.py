@@ -281,3 +281,42 @@ class TestPackaging:
         assert f"{data_dir}:{data_dir}:ro" in cmd  # datasets bind (ro)
         assert "example_worker_1.2.sif" in cmd  # cached SIF path
         assert (tmp_path / "ws").is_dir()       # created before bind
+
+
+class TestTracing:
+    def test_span_records_duration_and_nesting(self):
+        from bioengine_tpu.utils.tracing import clear_spans, get_spans, span
+
+        clear_spans()
+        with span("outer", app_id="a"):
+            with span("inner"):
+                pass
+        spans = get_spans()
+        assert [s["name"] for s in spans] == ["inner", "outer"]
+        inner, outer = spans
+        assert inner["parent_id"] == outer["span_id"]
+        assert outer["attrs"] == {"app_id": "a"}
+        assert outer["duration_s"] >= inner["duration_s"] >= 0
+
+    def test_span_failure_recorded_and_reraised(self):
+        from bioengine_tpu.utils.tracing import clear_spans, get_spans, span
+
+        clear_spans()
+        with pytest.raises(ValueError):
+            with span("boom"):
+                raise ValueError("x")
+        (s,) = get_spans(name="boom")
+        assert s["error"] == "ValueError: x"
+
+    def test_filter_and_limit(self):
+        from bioengine_tpu.utils.tracing import clear_spans, get_spans, span
+
+        clear_spans()
+        for i in range(5):
+            with span("a", i=i):
+                pass
+            with span("b"):
+                pass
+        assert len(get_spans(name="a")) == 5
+        assert len(get_spans(max_spans=3)) == 3
+        assert get_spans(name="a")[-1]["attrs"] == {"i": 4}
