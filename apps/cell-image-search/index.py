@@ -108,12 +108,16 @@ class IVFFlatIndex:
 
     @classmethod
     def build(
-        cls, embeddings: np.ndarray, nlist: int, nprobe: int = 16
+        cls,
+        embeddings: np.ndarray,
+        nlist: int,
+        nprobe: int = 16,
+        n_init: int = 3,
     ) -> "IVFFlatIndex":
         from sklearn.cluster import MiniBatchKMeans
 
         km = MiniBatchKMeans(
-            n_clusters=nlist, batch_size=4096, n_init=3, random_state=0
+            n_clusters=nlist, batch_size=4096, n_init=n_init, random_state=0
         )
         assignments = km.fit_predict(embeddings)
         return cls(embeddings, km.cluster_centers_, assignments, nprobe)
