@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Standalone app uploader — the analog of the reference's
+``scripts/upload_app.py`` (which pushes an app dir to the Hypha
+artifact manager). Two transports, auto-selected from the URL:
+
+- ``ws://host:port/ws``  — the worker's RPC plane (``upload_app`` with
+  in-memory file contents, same path as ``bioengine apps upload``)
+- ``http://host:port``   — the artifact manager's presigned-PUT flow
+  (bioengine_tpu/apps/artifact_http.py), usable without a websocket
+  client, e.g. from CI
+
+Usage:
+    python scripts/upload_app.py apps/demo-app \\
+        --server-url http://127.0.0.1:9527 --token $(cat ~/.bioengine/admin_token)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+
+def read_dir_files(src_dir: str | Path) -> dict[str, bytes]:
+    src = Path(src_dir)
+    return {
+        str(p.relative_to(src)): p.read_bytes()
+        for p in src.rglob("*")
+        if p.is_file() and not p.name.startswith(".")
+    }
+
+
+async def upload_ws(args) -> dict:
+    from bioengine_tpu.rpc.client import connect_to_server
+
+    conn = await connect_to_server(
+        {"server_url": args.server_url, "token": args.token}
+    )
+    try:
+        worker = await conn.get_service("bioengine-worker")
+        return await worker.upload_app(
+            files=read_dir_files(args.src_dir),
+            artifact_id=args.artifact_id,
+            version=args.version,
+        )
+    finally:
+        await conn.disconnect()
+
+
+def upload_http(args) -> dict:
+    from bioengine_tpu.apps.artifact_http import RemoteArtifactStore
+
+    store = RemoteArtifactStore(args.server_url, token=args.token)
+    try:
+        artifact_id, version = store.put_files(
+            read_dir_files(args.src_dir),
+            artifact_id=args.artifact_id,
+            version=args.version,
+        )
+        return {"artifact_id": artifact_id, "version": version}
+    finally:
+        store.close()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Upload a BioEngine app directory to a worker"
+    )
+    parser.add_argument("src_dir", help="app directory (with manifest.yaml)")
+    parser.add_argument(
+        "--server-url",
+        default=os.environ.get("BIOENGINE_SERVER_URL"),
+        help="ws://host:port/ws (RPC) or http://host:port (artifact "
+        "manager); env BIOENGINE_SERVER_URL",
+    )
+    parser.add_argument(
+        "--token",
+        default=os.environ.get("BIOENGINE_ADMIN_TOKEN"),
+        help="admin token; env BIOENGINE_ADMIN_TOKEN",
+    )
+    parser.add_argument("--artifact-id", default=None)
+    parser.add_argument("--version", default=None)
+    args = parser.parse_args(argv)
+    if not args.server_url:
+        parser.error("--server-url (or BIOENGINE_SERVER_URL) is required")
+    if not (Path(args.src_dir) / "manifest.yaml").is_file():
+        parser.error(f"{args.src_dir} has no manifest.yaml")
+
+    if args.server_url.startswith(("ws://", "wss://")):
+        result = asyncio.run(upload_ws(args))
+    else:
+        result = upload_http(args)
+    print(
+        f"uploaded {result['artifact_id']}@{result['version']} "
+        f"to {args.server_url}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,10 @@ from click.testing import CliRunner
 from bioengine_tpu.cli.cli import main as cli_main
 from bioengine_tpu.cli.utils import coerce_value, parse_kv_args, read_image, write_image
 
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
 pytestmark = [pytest.mark.end_to_end]
 
 REPO_APPS = __import__("pathlib").Path(__file__).resolve().parent.parent / "apps"
@@ -231,3 +235,71 @@ def test_cli_missing_server_url(monkeypatch):
     result = runner.invoke(cli_main, ["status"])
     assert result.exit_code != 0
     assert "server" in (result.stderr + str(result)).lower()
+
+
+@pytest.mark.anyio
+class TestStandaloneUploader:
+    """scripts/upload_app.py — ref scripts/upload_app.py analog, both
+    transports."""
+
+    async def test_http_transport(self, tmp_path):
+        import subprocess
+        import sys
+
+        from bioengine_tpu.apps.artifact_http import ArtifactHttpService
+        from bioengine_tpu.apps.artifacts import LocalArtifactStore
+        from bioengine_tpu.rpc.server import RpcServer
+
+        server = RpcServer(admin_users=["admin"])
+        await server.start()
+        token = server.issue_token("admin", is_admin=True)
+        backing = LocalArtifactStore(tmp_path / "store")
+        server.attach_artifact_service(ArtifactHttpService(backing, server))
+        try:
+            proc = await asyncio.to_thread(
+                subprocess.run,
+                [
+                    sys.executable,
+                    str(REPO_ROOT / "scripts" / "upload_app.py"),
+                    str(REPO_ROOT / "apps" / "demo-app"),
+                    "--server-url", server.http_url,
+                    "--token", token,
+                ],
+                capture_output=True, text=True, timeout=60,
+            )
+            assert proc.returncode == 0, proc.stderr
+            assert "uploaded demo-app@1.0.0" in proc.stdout
+            assert backing.list_artifacts() == ["demo-app"]
+        finally:
+            await server.stop()
+
+    async def test_ws_transport_requires_worker(self, tmp_path):
+        import subprocess
+        import sys
+
+        from bioengine_tpu.worker.worker import BioEngineWorker
+
+        w = BioEngineWorker(
+            mode="single-machine",
+            workspace_dir=tmp_path / "ws",
+            admin_users=["admin"],
+            monitoring_interval_seconds=60.0,
+            log_file="off",
+        )
+        await w.start()
+        try:
+            proc = await asyncio.to_thread(
+                subprocess.run,
+                [
+                    sys.executable,
+                    str(REPO_ROOT / "scripts" / "upload_app.py"),
+                    str(REPO_ROOT / "apps" / "demo-app"),
+                    "--server-url", w.server.url,
+                    "--token", w.admin_token,
+                ],
+                capture_output=True, text=True, timeout=60,
+            )
+            assert proc.returncode == 0, proc.stderr
+            assert "uploaded demo-app@" in proc.stdout
+        finally:
+            await w.stop()
