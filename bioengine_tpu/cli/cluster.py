@@ -35,3 +35,53 @@ def status_command(server_url, token):
         f"workers: {len(cluster.get('workers', []))}",
     ]
     emit(cluster, human="\n".join(lines))
+
+
+@cluster_group.command("traces")
+@click.option("--name", default=None, help="filter by span name")
+@click.option("--max-spans", default=30, type=int)
+@server_options
+def traces_command(name, max_spans, server_url, token):
+    """Recent control-plane spans (deploys, replica placements)."""
+
+    async def action(worker):
+        return await worker.get_traces(name=name, max_spans=max_spans)
+
+    spans = run_async(with_worker(server_url, token, action))
+    lines = [
+        f"{s['name']:<16} {s['duration_s']*1000:9.1f} ms  "
+        f"{s.get('attrs') or ''}"
+        + (f"  ERROR {s['error']}" if s.get("error") else "")
+        for s in spans
+    ]
+    emit(spans, human="\n".join(lines) or "no spans recorded")
+
+
+@cluster_group.command("profile")
+@click.option("--start", "action_name", flag_value="start",
+              help="start a jax.profiler trace on the worker")
+@click.option("--stop", "action_name", flag_value="stop",
+              help="stop the active trace")
+@click.option("--memory", "action_name", flag_value="memory",
+              help="device-memory snapshot (pprof + per-device stats)")
+@click.option("--trace-dir", default=None)
+@server_options
+def profile_command(action_name, trace_dir, server_url, token):
+    """Drive the worker's jax.profiler surface."""
+    if action_name is None:
+        raise click.UsageError("pass one of --start / --stop / --memory")
+
+    async def action(worker):
+        if action_name == "start":
+            return await worker.start_profiling(trace_dir=trace_dir)
+        if action_name == "stop":
+            return await worker.stop_profiling()
+        result = await worker.memory_profile()
+        # the pprof blob is for files, not terminals
+        return {
+            "devices": result["devices"],
+            "pprof_bytes": len(result["pprof_b64"]) * 3 // 4,
+        }
+
+    result = run_async(with_worker(server_url, token, action))
+    emit(result, human=str(result))

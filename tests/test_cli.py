@@ -303,3 +303,20 @@ class TestStandaloneUploader:
             assert "uploaded demo-app@" in proc.stdout
         finally:
             await w.stop()
+
+
+def test_cli_cluster_traces(live_worker):
+    result = _cli(live_worker, "cluster", "traces", "--name", "deploy_app")
+    assert result.exit_code == 0, result.stdout
+    spans = json.loads(result.stdout)
+    # the live_worker fixture deploys a startup app -> one deploy span
+    assert spans and spans[-1]["name"] == "deploy_app"
+    assert spans[-1]["duration_s"] >= 0
+
+
+def test_cli_cluster_profile_memory(live_worker):
+    result = _cli(live_worker, "cluster", "profile", "--memory")
+    assert result.exit_code == 0, result.stdout
+    payload = json.loads(result.stdout)
+    assert payload["devices"]
+    assert payload["pprof_bytes"] > 0
