@@ -56,7 +56,11 @@ def ring_attention(
     o0 = jnp.zeros((B, H, Nq, d), jnp.float32)
     # Accumulators must carry the same device-varying type as the loop
     # body's outputs (which derive from the sp-sharded q/k/v blocks).
-    m0, l0, o0 = jax.lax.pvary((m0, l0, o0), axis_name)
+    # jax >= 0.8 renames pvary -> pcast(..., to='varying').
+    if hasattr(jax.lax, "pcast"):
+        m0, l0, o0 = jax.lax.pcast((m0, l0, o0), axis_name, to="varying")
+    else:  # pragma: no cover - older jax
+        m0, l0, o0 = jax.lax.pvary((m0, l0, o0), axis_name)
 
     qf = q.astype(jnp.float32)
 
@@ -98,7 +102,10 @@ def make_ring_attention(mesh: Mesh, axis: str = "sp"):
     Drop-in for ``bioengine_tpu.models.vit.Attention(attn_fn=...)`` when
     a replica owns a multi-chip sub-mesh and sequences exceed one chip.
     """
-    from jax.experimental.shard_map import shard_map
+    # jax >= 0.8 promotes shard_map to the top level
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
 
     spec = P(None, None, axis, None)
 

@@ -65,7 +65,10 @@ def spatial_shard_apply(
     block, and crop the halo off the output. Correct for models whose
     receptive-field radius <= halo and whose output stride is 1.
     """
-    from jax.experimental.shard_map import shard_map
+    # jax >= 0.8 promotes shard_map to the top level
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
 
     @partial(
         shard_map,
