@@ -465,8 +465,12 @@ class ServeController:
             and depth == 0
             and len(healthy) > spec.min_replicas
         ):
-            victim = max(healthy, key=lambda r: r.load == 0.0)
-            if victim.load == 0.0:
+            # only a fully idle replica may be stopped (in-flight
+            # requests must never be cut); prefer the youngest so
+            # long-warm replicas with populated caches survive
+            idle = [r for r in healthy if r.load == 0.0]
+            if idle:
+                victim = idle[-1]
                 self.logger.info(
                     f"autoscale DOWN {app.app_id}/{spec.name} "
                     f"({victim.replica_id})"
