@@ -112,6 +112,9 @@ class AppServiceProxy:
                 unregister_mcp(self.built.app_id)
             self.mcp_url = None
             if self.rtc_service_id:
+                from bioengine_tpu.apps.webrtc import close_rtc_pcs
+
+                close_rtc_pcs(self)
                 self.server.unregister_service(self.rtc_service_id)
                 self.rtc_service_id = None
             self.server.unregister_service(self.service_id)

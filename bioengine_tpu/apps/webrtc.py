@@ -42,15 +42,34 @@ def maybe_register_rtc(server, proxy) -> Optional[str]:
     return _register(server, proxy)
 
 
+def close_rtc_pcs(proxy) -> int:
+    """Close every peer connection an app's RTC service still holds
+    (called from proxy.deregister — undeploy must not leak ICE/DTLS
+    sockets). Returns how many closes were scheduled."""
+    import asyncio
+
+    pcs = getattr(proxy, "_rtc_pcs", None)
+    if not pcs:
+        return 0
+    n = len(pcs)
+    for pc in list(pcs):
+        asyncio.ensure_future(pc.close())
+    pcs.clear()
+    return n
+
+
 def _register(server, proxy) -> str:
     from aiortc import RTCPeerConnection, RTCSessionDescription
 
     pcs: set[Any] = set()
+    proxy._rtc_pcs = pcs  # close_rtc_pcs reaches them on deregister
 
     async def offer(sdp: str, type: str = "offer", context=None) -> dict:
         """Answer an SDP offer; app methods ride the 'rpc' data channel
         with the caller context captured at signaling time (the ACL
-        decision uses the SAME identity as the websocket plane)."""
+        decision uses the SAME identity as the websocket plane).
+        NB the wire field is named ``type`` (SDP convention)."""
+        sdp_type = type
         pc = RTCPeerConnection()
         pcs.add(pc)
 
@@ -66,6 +85,7 @@ def _register(server, proxy) -> str:
                 import asyncio
 
                 async def respond():
+                    req = None
                     try:
                         req = json.loads(message)
                         value = await proxy.call_method(
@@ -80,14 +100,16 @@ def _register(server, proxy) -> str:
                                 {
                                     "id": (req.get("id")
                                            if isinstance(req, dict) else None),
-                                    "error": f"{type(e).__name__}: {e}",
+                                    "error": f"{e.__class__.__name__}: {e}",
                                 }
                             )
                         )
 
                 asyncio.ensure_future(respond())
 
-        await pc.setRemoteDescription(RTCSessionDescription(sdp=sdp, type=type))
+        await pc.setRemoteDescription(
+            RTCSessionDescription(sdp=sdp, type=sdp_type)
+        )
         answer = await pc.createAnswer()
         await pc.setLocalDescription(answer)
         return {

@@ -119,12 +119,19 @@ def parse_json_opt(raw: Optional[str], opt_name: str) -> Optional[dict]:
 
 def read_dir_files(src_dir: str | Path) -> dict[str, bytes]:
     """Read an app directory into the {relative_path: bytes} wire form
-    uploads use (the worker can't see the client's filesystem)."""
+    uploads use (the worker can't see the client's filesystem).
+
+    Hidden files AND files under hidden directories are skipped —
+    uploading an app dir that contains ``.git`` must not ship the
+    repository object store."""
     src = Path(src_dir)
     return {
         str(p.relative_to(src)): p.read_bytes()
         for p in sorted(src.rglob("*"))
         if p.is_file()
+        and not any(
+            part.startswith(".") for part in p.relative_to(src).parts
+        )
     }
 
 

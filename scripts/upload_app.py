@@ -26,13 +26,7 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO_ROOT))
 
 
-def read_dir_files(src_dir: str | Path) -> dict[str, bytes]:
-    src = Path(src_dir)
-    return {
-        str(p.relative_to(src)): p.read_bytes()
-        for p in src.rglob("*")
-        if p.is_file() and not p.name.startswith(".")
-    }
+from bioengine_tpu.cli.utils import read_dir_files  # noqa: E402 — path set above
 
 
 async def upload_ws(args) -> dict:

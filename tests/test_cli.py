@@ -320,3 +320,14 @@ def test_cli_cluster_profile_memory(live_worker):
     payload = json.loads(result.stdout)
     assert payload["devices"]
     assert payload["pprof_bytes"] > 0
+
+
+def test_read_dir_files_skips_hidden_dirs(tmp_path):
+    from bioengine_tpu.cli.utils import read_dir_files
+
+    (tmp_path / "manifest.yaml").write_text("x: 1")
+    (tmp_path / ".git" / "objects").mkdir(parents=True)
+    (tmp_path / ".git" / "objects" / "blob").write_bytes(b"secret")
+    (tmp_path / ".env").write_text("TOKEN=x")
+    files = read_dir_files(tmp_path)
+    assert set(files) == {"manifest.yaml"}
