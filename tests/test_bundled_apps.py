@@ -832,3 +832,75 @@ class TestAppFrontends:
             ) as r:
                 data = (await r.json())["result"]
                 assert data["sum"] == data["a"] + data["b"]
+
+
+class TestContinuousBatchingInRuntime:
+    """Concurrent predicts against the same model+shape run as one
+    batched engine call (serving/batching.py wired into the runtime —
+    the reference forwards each request individually)."""
+
+    async def test_concurrent_predicts_batch_and_match_direct(
+        self, model_collection
+    ):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "mr_rt2", REPO_APPS / "model-runner" / "runtime_deployment.py"
+        )
+        rt = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(rt)
+
+        dep = rt.RuntimeDeployment(batch_max=8, batch_wait_ms=50.0)
+        await dep.async_init()
+        rdf_path = str(model_collection / "tiny-unet")
+        rng = np.random.default_rng(0)
+        xs = [
+            rng.normal(size=(1, 64, 64, 1)).astype(np.float32)
+            for _ in range(6)
+        ]
+
+        # direct (unbatched) references, one by one
+        direct = []
+        for x in xs:
+            out = await dep.predict(rdf_path, x)
+            direct.append(out["output0"])
+
+        # concurrent: all six in flight -> grouped flushes
+        before = dep._batcher.stats
+        outs = await asyncio.gather(
+            *[dep.predict(rdf_path, x) for x in xs]
+        )
+        after = dep._batcher.stats
+        grouped_requests = after["batched_requests"] - before["batched_requests"]
+        grouped_batches = after["batches"] - before["batches"]
+        assert grouped_requests == 6
+        assert grouped_batches < 6, "no batching happened"
+
+        for got, want in zip(outs, direct):
+            np.testing.assert_allclose(
+                got["output0"], want, rtol=1e-4, atol=1e-4
+            )
+        assert all(o["_meta"]["backend"] for o in outs)
+
+    async def test_mismatched_shapes_do_not_cross_batch(
+        self, model_collection
+    ):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "mr_rt3", REPO_APPS / "model-runner" / "runtime_deployment.py"
+        )
+        rt = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(rt)
+
+        dep = rt.RuntimeDeployment(batch_max=8, batch_wait_ms=50.0)
+        await dep.async_init()
+        rdf_path = str(model_collection / "tiny-unet")
+        rng = np.random.default_rng(1)
+        a = rng.normal(size=(1, 64, 64, 1)).astype(np.float32)
+        b = rng.normal(size=(1, 32, 32, 1)).astype(np.float32)
+        ra, rb = await asyncio.gather(
+            dep.predict(rdf_path, a), dep.predict(rdf_path, b)
+        )
+        assert ra["output0"].shape[1:3] == (64, 64)
+        assert rb["output0"].shape[1:3] == (32, 32)
