@@ -132,14 +132,8 @@ async def handle_message(
 
 
 def _jsonable(obj: Any) -> Any:
-    import numpy as np
+    # shares the bridge's conversion incl. non-finite-float -> null
+    # (MCP clients parse with strict JSON too)
+    from bioengine_tpu.rpc.server import _to_jsonable
 
-    if isinstance(obj, np.ndarray):
-        return obj.tolist()
-    if isinstance(obj, np.generic):
-        return obj.item()
-    if isinstance(obj, dict):
-        return {k: _jsonable(v) for k, v in obj.items()}
-    if isinstance(obj, (list, tuple)):
-        return [_jsonable(v) for v in obj]
-    return obj
+    return _to_jsonable(obj)

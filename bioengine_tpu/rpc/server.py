@@ -35,13 +35,22 @@ from bioengine_tpu.utils.logger import create_logger
 
 def _to_jsonable(obj: Any) -> Any:
     """Numpy-aware conversion for the JSON HTTP bridge (service results
-    may carry arrays, e.g. segmentation masks)."""
+    may carry arrays, e.g. segmentation masks). Non-finite floats
+    become null: Python's json emits bare NaN/Infinity literals, which
+    browsers' JSON.parse rejects — a diverged training loss must not
+    break the frontend."""
+    import math
+
     import numpy as np
 
     if isinstance(obj, np.ndarray):
+        if np.issubdtype(obj.dtype, np.floating) and not np.isfinite(obj).all():
+            return _to_jsonable(obj.tolist())
         return obj.tolist()
     if isinstance(obj, np.generic):
-        return obj.item()
+        obj = obj.item()
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return None
     if isinstance(obj, dict):
         return {k: _to_jsonable(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):

@@ -206,3 +206,35 @@ class TestTokenIdentityFallback:
         info = server.validate_token(token)
         assert info.user_id == "admin"
         assert info.workspace == "bioengine"
+
+
+def test_http_bridge_jsonable_sanitizes_nonfinite():
+    """NaN/Inf must become null — browsers' JSON.parse rejects Python's
+    bare NaN literals (a diverged loss must not break the frontend)."""
+    import json
+    import math
+
+    import numpy as np
+
+    from bioengine_tpu.rpc.server import _to_jsonable
+
+    payload = _to_jsonable(
+        {
+            "loss": float("nan"),
+            "losses": [1.0, float("inf"), 2.0],
+            "arr": np.array([1.0, np.nan]),
+            "ok_arr": np.arange(3),
+            "nested": {"v": np.float32("inf")},
+        }
+    )
+    text = json.dumps(payload, allow_nan=False)  # raises if any slipped by
+    back = json.loads(text)
+    assert back["loss"] is None
+    assert back["losses"] == [1.0, None, 2.0]
+    assert back["arr"] == [1.0, None]
+    assert back["ok_arr"] == [0, 1, 2]
+    assert back["nested"]["v"] is None
+    assert not any(
+        isinstance(v, float) and not math.isfinite(v)
+        for v in back["losses"] if v is not None
+    )
