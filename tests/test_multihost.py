@@ -415,3 +415,55 @@ async def test_protected_host_service_rejects_non_admin(control_plane, tmp_path)
     finally:
         host.terminate()
         host.wait(timeout=10)
+
+
+async def test_run_code_host_death_fails_fast_and_releases_lease(
+    control_plane, tmp_path
+):
+    """SIGKILL the worker host while run_code executes there: the
+    in-flight RPC fails immediately (provider-disconnect fail-fast in
+    rpc/server.py _drop_client) and the chip lease is released."""
+    from bioengine_tpu.utils.permissions import create_context
+    from bioengine_tpu.worker.code_executor import CodeExecutor
+
+    server, controller, token = control_plane
+    executor = CodeExecutor(
+        admin_users=["admin"],
+        cluster_state=controller.cluster_state,
+        call_host=controller._call_host,
+    )
+    host = _spawn_host(server.url, token, "hkill", tmp_path)
+    try:
+        await _wait_for_host(controller, "hkill")
+        slow_code = (
+            "import time\n"
+            "def main():\n"
+            "    time.sleep(60)\n"
+            "    return 'never'\n"
+        )
+        task = asyncio.create_task(
+            executor.run_code(
+                code=slow_code,
+                remote_options={"num_chips": 1},
+                timeout=90.0,
+                context=create_context("admin"),
+            )
+        )
+        # wait until the lease lands on the host, then kill it
+        deadline = time.time() + 20
+        hrec = controller.cluster_state.hosts["hkill"]
+        while not hrec.chips_in_use and time.time() < deadline:
+            await asyncio.sleep(0.1)
+        assert hrec.chips_in_use, "run_code never leased chips"
+        host.kill()
+        t0 = time.time()
+        with pytest.raises(ConnectionError):
+            await task
+        # fail-fast: well under the 90s call timeout
+        assert time.time() - t0 < 20
+        # the finally-block released the lease despite the error
+        assert hrec.chips_in_use == {}
+    finally:
+        if host.poll() is None:
+            host.kill()
+        host.wait(timeout=10)
