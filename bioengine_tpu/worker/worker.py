@@ -156,6 +156,13 @@ class BioEngineWorker:
             await self.datasets_server.start()
         self.datasets_client = self._make_datasets_client()
 
+        # built-in operator dashboard at /apps/_dashboard/ (the
+        # reference leans on an external dashboard site reading its
+        # Hypha service; ours is self-served)
+        dashboard = Path(__file__).resolve().parent / "dashboard"
+        if dashboard.is_dir():
+            self.server.register_static_dir("_dashboard", dashboard)
+
         self._write_admin_token()
         # provisioned worker_host processes join THIS control plane
         self.cluster.provisioner.set_join_info(self.server.url, self.admin_token)

@@ -401,3 +401,25 @@ async def test_worker_profiling_service(worker, tmp_path):
 
     assert len(base64.b64decode(mem["pprof_b64"])) > 0
     assert mem["devices"]
+
+
+async def test_worker_dashboard_served(worker):
+    """The built-in dashboard is served at /apps/_dashboard/ and its
+    data endpoints (get_status via the bridge, /services) respond."""
+    import aiohttp
+
+    base = f"http://{worker.server.host}:{worker.server.port}"
+    async with aiohttp.ClientSession() as http:
+        async with http.get(f"{base}/apps/_dashboard/") as r:
+            assert r.status == 200
+            page = await r.text()
+        assert "Worker Dashboard" in page
+        async with http.post(
+            f"{base}/call/bioengine-worker/get_status", json={}
+        ) as r:
+            status = (await r.json())["result"]
+            assert status["worker"]["ready"] is True
+            assert status["applications"]
+        async with http.get(f"{base}/services") as r:
+            services = await r.json()
+            assert any(s["type"] == "bioengine-worker" for s in services)
