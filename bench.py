@@ -334,6 +334,22 @@ def worker_main() -> int:
 # ---------------------------------------------------------------------------
 
 
+def _tunnel_alive(timeout: float = 60.0) -> bool:
+    """Cheap subprocess probe: a wedged TPU tunnel hangs jax.devices()
+    forever (observed r4: hours), so burning a full BENCH_TIMEOUT
+    attempt on it wastes the driver's budget. 30s covers a healthy
+    cold backend init."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True,
+            timeout=timeout,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> int:
     if "--worker" in sys.argv:
         return worker_main()
@@ -353,6 +369,27 @@ def main() -> int:
         ]
         if attempt > 1 and not remaining:
             break
+        # gate each attempt on a cheap tunnel probe (skipped on cpu)
+        if os.environ.get("BENCH_PLATFORM", "").lower() != "cpu":
+            probe_waits = [0, 30, 60]
+            alive = False
+            for wait in probe_waits:
+                if wait:
+                    time.sleep(wait)
+                if _tunnel_alive():
+                    alive = True
+                    break
+            if not alive:
+                diagnostics.append(
+                    {
+                        "attempt": attempt,
+                        "rc": None,
+                        "stderr_tail": "tunnel probe: jax.devices() hung "
+                        f"across {len(probe_waits)} probes — attempt skipped",
+                        "probe": {"ok": False, "tunnel_wedged": True},
+                    }
+                )
+                continue
         env = dict(os.environ)
         if remaining:
             env["BENCH_CONFIGS"] = ",".join(remaining)
