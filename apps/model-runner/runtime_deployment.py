@@ -184,23 +184,28 @@ class Pipeline:
     def output_spec(self):
         return self.rdf.outputs[0]
 
-    def predict(self, inputs) -> dict[str, np.ndarray]:
-        """inputs: array | {input_name: array} -> {output_name: array}.
-
-        Arrays arrive in the RDF's declared axes, are canonicalized to
-        NHWC for the engine, and returned in the declared output axes.
-        """
+    @staticmethod
+    def extract_array(inputs) -> np.ndarray:
+        """array | single-entry {input_name: array} -> f32 array (the
+        single source of the single-input contract; shared with the
+        deployment's batching path)."""
         if isinstance(inputs, dict):
             if len(inputs) != 1:
                 raise ValueError(
                     "the TPU runtime currently executes single-input "
                     f"models; got {sorted(inputs)}"
                 )
-            array = next(iter(inputs.values()))
-        else:
-            array = inputs
+            inputs = next(iter(inputs.values()))
+        return np.asarray(inputs, np.float32)
+
+    def predict(self, inputs) -> dict[str, np.ndarray]:
+        """inputs: array | {input_name: array} -> {output_name: array}.
+
+        Arrays arrive in the RDF's declared axes, are canonicalized to
+        NHWC for the engine, and returned in the declared output axes.
+        """
         spec = self.input_spec
-        x = to_nhwc(np.asarray(array, np.float32), spec.axes)
+        x = to_nhwc(self.extract_array(inputs), spec.axes)
         x = apply_processing(x, spec.preprocessing)
         y = self.engine.predict(x)  # InferenceEngine and TorchFallbackRunner share .predict
         out_spec = self.output_spec
@@ -382,10 +387,17 @@ class RuntimeDeployment:
             pipeline = await self._get_pipeline(
                 rdf_path, weights_format, default_blocksize_parameter
             )
-            array = self._extract_array(pipeline, inputs)
+            array = pipeline.extract_array(inputs)
             if self._batchable(pipeline, array):
+                # the full pipeline-cache key, NOT just the model key —
+                # same model with different weights_format/blocksize is
+                # a different pipeline and must never co-batch
                 signature = (
-                    pipeline._model_key(),
+                    self._cache_key(
+                        rdf_path,
+                        weights_format=weights_format,
+                        blocksize=default_blocksize_parameter,
+                    ),
                     tuple(array.shape[1:]),
                 )
                 result = await self._batcher.submit(
@@ -406,16 +418,30 @@ class RuntimeDeployment:
             },
         }
 
-    @staticmethod
-    def _extract_array(pipeline: Pipeline, inputs) -> np.ndarray:
-        if isinstance(inputs, dict):
-            if len(inputs) != 1:
-                raise ValueError(
-                    "the TPU runtime currently executes single-input "
-                    f"models; got {sorted(inputs)}"
-                )
-            inputs = next(iter(inputs.values()))
-        return np.asarray(inputs, np.float32)
+    # processing ops that treat each sample independently (or use fixed
+    # constants), so co-batched requests can't contaminate each other's
+    # statistics — batch-global zero_mean/scale_range must NOT co-batch
+    # (their mean/percentiles would mix requests)
+    _PER_SAMPLE_SAFE_OPS = frozenset(
+        {"scale_linear", "sigmoid", "binarize", "clip"}
+    )
+
+    @classmethod
+    def _processing_per_sample_safe(cls, ops) -> bool:
+        for op in ops or []:
+            name = op.get("name", op.get("id"))
+            kw = op.get("kwargs", {}) or {}
+            if name in cls._PER_SAMPLE_SAFE_OPS:
+                continue
+            if (
+                name in ("zero_mean_unit_variance",
+                         "fixed_zero_mean_unit_variance")
+                and (kw.get("mean") is not None
+                     or kw.get("mode") == "per_sample")
+            ):
+                continue  # fixed constants or per-sample stats
+            return False
+        return True
 
     def _batchable(self, pipeline: Pipeline, array: np.ndarray) -> bool:
         return (
@@ -423,6 +449,12 @@ class RuntimeDeployment:
             and pipeline.input_spec.axes.startswith("b")
             and pipeline.output_spec.axes.startswith("b")
             and array.ndim == len(pipeline.input_spec.axes)
+            and self._processing_per_sample_safe(
+                pipeline.input_spec.preprocessing
+            )
+            and self._processing_per_sample_safe(
+                pipeline.output_spec.postprocessing
+            )
         )
 
     @schema_method

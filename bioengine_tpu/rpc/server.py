@@ -45,7 +45,11 @@ def _to_jsonable(obj: Any) -> Any:
 
     if isinstance(obj, np.ndarray):
         if np.issubdtype(obj.dtype, np.floating) and not np.isfinite(obj).all():
-            return _to_jsonable(obj.tolist())
+            # vectorized: one NaN in a megapixel map must not trigger
+            # per-element Python recursion
+            masked = obj.astype(object)
+            masked[~np.isfinite(obj)] = None
+            return masked.tolist()
         return obj.tolist()
     if isinstance(obj, np.generic):
         obj = obj.item()
