@@ -589,6 +589,50 @@ class TestRemoteArtifacts:
         # body is a JSON error, not file content
         assert raw.split(b"\r\n\r\n", 1)[1].startswith(b'{"error"')
 
+    async def test_upload_grant_expiry_and_stage_gc(self, artifact_plane):
+        """Expired presign grants are rejected and abandoned stages are
+        purged (worker RAM must not grow forever)."""
+        import time as _time
+
+        import aiohttp
+
+        from bioengine_tpu.apps import artifact_http
+
+        server, remote, token = artifact_plane
+        svc = server.artifact_service
+        base = server.http_url
+        async with aiohttp.ClientSession() as http:
+            # presign, then force-expire the grant
+            async with http.post(
+                f"{base}/artifacts/gc-app/put_url",
+                json={"path": "a.txt"},
+                headers={"Authorization": f"Bearer {token}"},
+            ) as r:
+                url = (await r.json())["url"]
+            sig = url.split("sig=")[1]
+            aid, path, _ = svc._grants[sig]
+            svc._grants[sig] = (aid, path, _time.time() - 1)
+            async with http.put(f"{base}{url}", data=b"late") as r:
+                assert r.status == 401
+            # a fresh presign GCs the expired grant
+            async with http.post(
+                f"{base}/artifacts/gc-app/put_url",
+                json={"path": "b.txt"},
+                headers={"Authorization": f"Bearer {token}"},
+            ) as r:
+                url2 = (await r.json())["url"]
+            assert sig not in svc._grants
+            # stage a file, then age it past STAGE_TTL: purged on next GC
+            async with http.put(f"{base}{url2}", data=b"data") as r:
+                assert r.status == 200
+            assert svc._staged["gc-app"]
+            svc._stage_touched["gc-app"] = (
+                _time.time() - artifact_http.STAGE_TTL - 1
+            )
+            svc._gc()
+            assert "gc-app" not in svc._staged
+
+
 
 class TestMcpEndpoint:
     """Per-app MCP service parity (VERDICT r3 missing #5/#10): every
@@ -760,3 +804,4 @@ class TestWebRtcGate:
             caller=server.validate_token(server.issue_token("u")),
         )
         assert out["pong"] is True
+
