@@ -20,6 +20,7 @@ snapshots, restart-from-snapshot — and replaces the compute:
 """
 
 import asyncio
+import contextlib
 import json
 import shutil
 import time
@@ -30,6 +31,9 @@ import numpy as np
 import yaml
 
 from bioengine_tpu.rpc import schema_method
+
+# session states with no train thread behind them anymore
+_TERMINAL_STATES = ("completed", "failed", "stopped", "interrupted")
 
 DEFAULT_CONFIG = {
     # "unet" = CellposeNet (residual U-Net); "sam" = CellposeSAM, the
@@ -107,6 +111,8 @@ class TrainingSession:
         self.data_dir.mkdir(parents=True, exist_ok=True)
         self.config = config
         self.task: asyncio.Task | None = None
+        # True while start_training is still writing this session's data
+        self.preparing = False
 
     # ---- status.json protocol (ref main.py:1740-1900) --------------------
 
@@ -173,8 +179,26 @@ class CellposeFinetune:
         self.sessions_root = Path(sessions_root).expanduser()
         self.sessions_root.mkdir(parents=True, exist_ok=True)
         self.sessions: dict[str, TrainingSession] = {}
+        # serializes start/stop/restart/delete per session id — the busy
+        # check can suspend (waiting out a task wind-down), so without
+        # a lock two callers could both pass it and then both mutate.
+        # value = [lock, refcount]; the entry is reclaimed when the last
+        # holder/waiter leaves, so ids probed once don't accumulate
+        self._locks: dict[str, list] = {}
         self._fwd_cache: dict[tuple, object] = {}  # features -> jitted forward
         self._recover_sessions()
+
+    @contextlib.asynccontextmanager
+    async def _lifecycle_lock(self, session_id: str):
+        entry = self._locks.setdefault(session_id, [asyncio.Lock(), 0])
+        entry[1] += 1
+        try:
+            async with entry[0]:
+                yield
+        finally:
+            entry[1] -= 1
+            if entry[1] == 0 and self._locks.get(session_id) is entry:
+                del self._locks[session_id]
 
     def _recover_sessions(self) -> None:
         """Re-adopt session dirs from a previous replica life (the
@@ -394,33 +418,21 @@ class CellposeFinetune:
         ``get_training_status``."""
         cfg = {**DEFAULT_CONFIG, **(config or {})}
         session_id = session_id or f"session-{uuid.uuid4().hex[:8]}"
-        existing = self.sessions.get(session_id)
-        if existing is not None and (
-            existing.task is None or not existing.task.done()
-        ):
-            # status.json is written from inside the train thread, so a
-            # terminal status can land a beat before the task resolves —
-            # let the task wind down instead of rejecting the reuse
-            terminal = existing.read_status().get("status") in (
-                "completed", "failed", "stopped",
-            )
-            if terminal and existing.task is not None:
-                await asyncio.wait_for(
-                    asyncio.shield(existing.task), timeout=30
-                )
-            else:
-                # task None = registered by a concurrent start_training
-                # still preparing data — treat as training to close the race
-                raise RuntimeError(
-                    f"session '{session_id}' already training"
-                )
-        # a reused id is a fresh run: stale snapshots/data would poison
-        # restart_training's epoch counting and live inference
-        old_dir = self.sessions_root / session_id
-        if old_dir.exists():
-            shutil.rmtree(old_dir)
-        session = TrainingSession(self.sessions_root, session_id, cfg)
-        self.sessions[session_id] = session  # claim the id before awaiting
+        async with self._lifecycle_lock(session_id):
+            existing = self.sessions.get(session_id)
+            if existing is not None and await self._busy(existing):
+                raise RuntimeError(f"session '{session_id}' already training")
+            # a reused id is a fresh run: stale snapshots/data would poison
+            # restart_training's epoch counting and live inference
+            old_dir = self.sessions_root / session_id
+            if old_dir.exists():
+                await asyncio.to_thread(shutil.rmtree, old_dir)
+            session = TrainingSession(self.sessions_root, session_id, cfg)
+            # claim the id with ``preparing`` set before releasing the
+            # lock — other mutators fail fast instead of queueing for
+            # the whole (potentially long) data-prep below
+            session.preparing = True
+            self.sessions[session_id] = session
         try:
             (session.dir / "config.json").write_text(json.dumps(cfg))
             session.write_status(
@@ -428,38 +440,52 @@ class CellposeFinetune:
                 n_images=len(train_images),
             )
             await asyncio.to_thread(
-                self._prepare_training_data, session, train_images, train_labels
+                self._prepare_training_data,
+                session, train_images, train_labels,
+            )
+            # spawn before clearing ``preparing`` so there is no instant
+            # where the session is neither preparing nor tracked by a task
+            session.task = asyncio.create_task(
+                self._run_training(session, False)
             )
         except BaseException:
-            del self.sessions[session_id]
+            self.sessions.pop(session_id, None)
+            # don't leave a half-initialized dir for _recover_sessions
+            # to re-adopt as a ghost session after a restart
+            shutil.rmtree(session.dir, ignore_errors=True)
             raise
-        session.task = asyncio.create_task(self._run_training(session, False))
+        finally:
+            session.preparing = False
         return {"session_id": session_id, "status": "started"}
 
     @schema_method
     async def stop_training(self, session_id: str, context=None):
         """Request a graceful stop (checked per batch, like the
         reference's stop-file, ref main.py:1278-1360)."""
-        session = self._get_session(session_id)
-        session.stop_path.touch()
-        if session.task:
-            await asyncio.wait([session.task], timeout=30)
-        return session.read_status()
+        async with self._lifecycle_lock(session_id):
+            session = self._get_session(session_id)
+            session.stop_path.touch()
+            if session.task:
+                await asyncio.wait([session.task], timeout=30)
+            return session.read_status()
 
     @schema_method
     async def restart_training(self, session_id: str, context=None):
         """Resume a stopped/interrupted/failed session from its latest
         snapshot (ref main.py:4117)."""
-        session = self._get_session(session_id)
-        if session.task and not session.task.done():
-            raise RuntimeError(f"session '{session_id}' is still running")
-        if not (session.data_dir / "train.npz").exists():
-            raise RuntimeError(
-                f"session '{session_id}' has no persisted training data"
+        async with self._lifecycle_lock(session_id):
+            session = self._get_session(session_id)
+            if await self._busy(session):
+                raise RuntimeError(f"session '{session_id}' is still running")
+            if not (session.data_dir / "train.npz").exists():
+                raise RuntimeError(
+                    f"session '{session_id}' has no persisted training data"
+                )
+            session.stop_path.unlink(missing_ok=True)
+            session.write_status(status="initializing", error=None)
+            session.task = asyncio.create_task(
+                self._run_training(session, True)
             )
-        session.stop_path.unlink(missing_ok=True)
-        session.write_status(status="initializing", error=None)
-        session.task = asyncio.create_task(self._run_training(session, True))
         return {"session_id": session_id, "status": "restarted"}
 
     @schema_method
@@ -478,14 +504,45 @@ class CellposeFinetune:
             for s in self.sessions.values()
         ]
 
+    async def _busy(self, session) -> bool:
+        """True if the session must not be mutated right now.
+
+        status.json is written from inside the train thread, so a
+        terminal status can land a beat before the asyncio task itself
+        completes — callers that gate on "not training" wait out that
+        wind-down here instead of rejecting a session the status file
+        already reports finished. Callers must hold the session's
+        lifecycle lock: this method can suspend, and the lock is what
+        keeps a concurrent mutator from acting in that window.
+
+        A task-less, non-preparing session (re-adopted after an app
+        restart, including one that crashed mid-initialization) has
+        nothing running in this process and is never busy."""
+        if session.preparing:
+            return True
+        if session.task is None or session.task.done():
+            return False
+        if session.read_status().get("status") not in _TERMINAL_STATES:
+            return True
+        try:
+            await asyncio.wait_for(asyncio.shield(session.task), timeout=30)
+        except asyncio.TimeoutError:
+            return True
+        return False
+
     @schema_method
     async def delete_session(self, session_id: str, context=None):
         """Remove a session directory (must not be training)."""
-        session = self._get_session(session_id)
-        if session.task and not session.task.done():
-            raise RuntimeError(f"stop session '{session_id}' first")
-        shutil.rmtree(session.dir, ignore_errors=True)
-        del self.sessions[session_id]
+        async with self._lifecycle_lock(session_id):
+            session = self._get_session(session_id)
+            if await self._busy(session):
+                raise RuntimeError(f"stop session '{session_id}' first")
+            # deregister first so infer/export on this id fail fast
+            # instead of racing the threaded rmtree below
+            self.sessions.pop(session_id, None)
+            await asyncio.to_thread(
+                shutil.rmtree, session.dir, ignore_errors=True
+            )
         return {"deleted": session_id}
 
     @schema_method

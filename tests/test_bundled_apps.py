@@ -568,6 +568,80 @@ class TestCellposeFinetune:
         assert not (tmp_path / "sessions" / "session-del").exists()
 
 
+class TestCellposeSettled:
+    """Unit coverage for the status-file/task wind-down race: a terminal
+    status.json lands a beat before the asyncio task resolves, and
+    delete/restart/start must wait it out instead of erroring."""
+
+    @pytest.fixture
+    def app_cls(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "cellpose_main_unit", REPO_APPS / "cellpose-finetuning" / "main.py"
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def _session(self, mod, tmp_path, status):
+        s = mod.TrainingSession(tmp_path, "s1", {})
+        s.write_status(status=status)
+        return s
+
+    async def test_terminal_status_waits_for_task_windup(self, app_cls, tmp_path):
+        app = app_cls.CellposeFinetune(sessions_root=str(tmp_path))
+        s = self._session(app_cls, tmp_path, "completed")
+        s.task = asyncio.create_task(asyncio.sleep(0.3))  # still winding down
+        app.sessions["s1"] = s
+        out = await app.delete_session(session_id="s1")
+        assert out == {"deleted": "s1"}
+        assert not s.dir.exists()
+
+    async def test_running_session_rejected_immediately(self, app_cls, tmp_path):
+        app = app_cls.CellposeFinetune(sessions_root=str(tmp_path))
+        s = self._session(app_cls, tmp_path, "training")
+        s.task = asyncio.create_task(asyncio.sleep(30))
+        app.sessions["s1"] = s
+        with pytest.raises(RuntimeError, match="stop session"):
+            await app.delete_session(session_id="s1")
+        with pytest.raises(RuntimeError, match="still running"):
+            await app.restart_training(session_id="s1")
+        s.task.cancel()
+
+    async def test_preparing_session_not_deletable(self, app_cls, tmp_path):
+        app = app_cls.CellposeFinetune(sessions_root=str(tmp_path))
+        s = self._session(app_cls, tmp_path, "initializing")
+        s.preparing = True
+        app.sessions["s1"] = s
+        with pytest.raises(RuntimeError, match="stop session"):
+            await app.delete_session(session_id="s1")
+
+    async def test_concurrent_deletes_serialized(self, app_cls, tmp_path):
+        # both suspend in the wind-down wait; the lifecycle lock makes
+        # exactly one win — the loser gets a clean unknown-session error
+        app = app_cls.CellposeFinetune(sessions_root=str(tmp_path))
+        s = self._session(app_cls, tmp_path, "completed")
+        s.task = asyncio.create_task(asyncio.sleep(0.3))
+        app.sessions["s1"] = s
+        results = await asyncio.gather(
+            app.delete_session(session_id="s1"),
+            app.delete_session(session_id="s1"),
+            return_exceptions=True,
+        )
+        oks = [r for r in results if r == {"deleted": "s1"}]
+        errs = [r for r in results if isinstance(r, KeyError)]
+        assert len(oks) == 1 and len(errs) == 1, results
+
+    async def test_readopted_session_deletable(self, app_cls, tmp_path):
+        # re-adopted after an app restart: terminal status, no task
+        app = app_cls.CellposeFinetune(sessions_root=str(tmp_path))
+        s = self._session(app_cls, tmp_path, "interrupted")
+        app.sessions["s1"] = s
+        out = await app.delete_session(session_id="s1")
+        assert out == {"deleted": "s1"}
+
+
 class TestTpuTest:
     async def test_ping_and_device_probe(self, stack):
         manager, _, server, _ = stack
