@@ -115,6 +115,7 @@ class Pipeline:
                 apply_fn=lambda prm, x: model.apply({"params": prm}, x),
                 params=params,
                 divisor=getattr(model, "divisor", 1),
+                z_divisor=getattr(model, "z_divisor", 1),
                 config=config,
             )
             return "xla", engine
@@ -223,7 +224,12 @@ class Pipeline:
         test_in = self._load_test_arrays("inputs", "test_inputs")
         if test_in is None:
             spec = self.input_spec
-            shape = [1 if a in "bc" else 64 for a in spec.axes.lower()]
+            # z kept thin: synthesized 3D self-tests shouldn't pay a
+            # 64^3 volume when 16 planes exercise the same code path
+            shape = [
+                1 if a in "bc" else (16 if a == "z" else 64)
+                for a in spec.axes.lower()
+            ]
             test_in = np.random.default_rng(0).normal(size=shape).astype(
                 np.float32
             )

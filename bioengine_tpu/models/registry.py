@@ -43,6 +43,13 @@ def _unet2d(**kw) -> nn.Module:
     return UNet2D(**kw)
 
 
+@register_model("unet3d")
+def _unet3d(**kw) -> nn.Module:
+    from bioengine_tpu.models.unet3d import UNet3D
+
+    return UNet3D(**kw)
+
+
 @register_model("cellpose")
 def _cellpose(**kw) -> nn.Module:
     from bioengine_tpu.models.cellpose import CellposeNet

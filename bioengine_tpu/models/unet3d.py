@@ -1,0 +1,102 @@
+"""3D U-Net in Flax — the volumetric member of the BioImage Model Zoo
+segmentation family (light-sheet / FIB-SEM / confocal stacks). The
+reference executes zoo 3D U-Nets through bioimageio.core's torch path
+with blockwise tiling (ref apps/model-runner/runtime_deployment.py:277-280);
+here the same family runs jitted on TPU behind the InferenceEngine's
+volumetric tiled path (bioengine_tpu/runtime/engine.py).
+
+TPU-first choices (mirrors models/unet.py):
+- NDHWC layout: XLA lowers 3D convs to MXU contractions with the
+  channel dim innermost, same as 2D.
+- GroupNorm, bf16 compute / f32 params, static pool factors.
+- Anisotropic option: microscopy stacks usually have coarser z than xy,
+  so ``z_strides`` can keep z unpooled at chosen levels (the classic
+  anisotropic 3D U-Net recipe) — then the z bucket divisor stays small
+  and thin stacks don't over-pad.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class ConvBlock3D(nn.Module):
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        for _ in range(2):
+            x = nn.Conv(
+                self.features, (3, 3, 3), padding="SAME", dtype=self.dtype
+            )(x)
+            x = nn.GroupNorm(
+                num_groups=min(32, self.features), dtype=self.dtype
+            )(x)
+            x = nn.silu(x)
+        return x
+
+
+class UNet3D(nn.Module):
+    """Volumetric encoder-decoder with skip connections.
+
+    in:  (B, D, H, W, C_in) with H, W divisible by ``divisor`` and
+         D divisible by ``z_divisor``.
+    out: (B, D, H, W, out_channels) logits.
+
+    ``z_strides[i]`` is the z pooling factor at encoder level i
+    (1 = keep z resolution at that level — the anisotropic recipe).
+    """
+
+    features: Sequence[int] = (16, 32, 64)
+    out_channels: int = 1
+    z_strides: Sequence[int] | None = None   # default: isotropic (all 2)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    def _z_strides(self) -> tuple[int, ...]:
+        if self.z_strides is None:
+            return tuple(2 for _ in self.features[:-1])
+        zs = tuple(int(s) for s in self.z_strides)
+        if len(zs) != len(self.features) - 1:
+            raise ValueError(
+                f"z_strides needs {len(self.features) - 1} entries "
+                f"(one per pooling level), got {len(zs)}"
+            )
+        return zs
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        z_strides = self._z_strides()
+        skips = []
+        for feats, zs in zip(self.features[:-1], z_strides):
+            x = ConvBlock3D(feats, self.dtype)(x)
+            skips.append(x)
+            x = nn.max_pool(x, (zs, 2, 2), strides=(zs, 2, 2))
+        x = ConvBlock3D(self.features[-1], self.dtype)(x)
+        for feats, zs, skip in zip(
+            reversed(self.features[:-1]),
+            reversed(z_strides),
+            reversed(skips),
+        ):
+            x = nn.ConvTranspose(
+                feats, (zs, 2, 2), strides=(zs, 2, 2), dtype=self.dtype
+            )(x)
+            x = jnp.concatenate([x, skip], axis=-1)
+            x = ConvBlock3D(feats, self.dtype)(x)
+        return nn.Conv(self.out_channels, (1, 1, 1), dtype=jnp.float32)(x)
+
+    @property
+    def divisor(self) -> int:
+        """xy bucket divisor (pooling is always 2x per level in-plane)."""
+        return 2 ** (len(self.features) - 1)
+
+    @property
+    def z_divisor(self) -> int:
+        out = 1
+        for zs in self._z_strides():
+            out *= zs
+        return out

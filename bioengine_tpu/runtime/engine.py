@@ -22,7 +22,7 @@ import numpy as np
 from bioengine_tpu.runtime.buckets import (
     DEFAULT_LADDER,
     bucket_batch,
-    bucket_shape,
+    bucket_dim,
     crop_to,
     pad_to,
 )
@@ -38,17 +38,33 @@ class EngineConfig:
     tile: int = 512
     tile_overlap: int = 64
     ladder: tuple = DEFAULT_LADDER
+    # tiled predictions run their tiles through the device in chunks of
+    # this many — an unbounded tile batch would OOM on large stacks
+    tile_batch: int = 16
+    # volumetric (B, D, H, W, C) inputs: z gets its own, smaller ladder
+    # (stacks are usually far thinner than wide) and its own tile size
+    max_tile_z: int = 64          # volumes deeper than this tile in z too
+    tile_z: int = 32
+    tile_overlap_z: int = 8
+    ladder_z: tuple = (8, 16, 24, 32, 48, 64, 96, 128)
 
 
 class InferenceEngine:
     """Wraps one model (apply_fn + params) behind bucketed jit programs.
 
     ``apply_fn(params, images)``: (B, H, W, C) -> (B, H, W, C_out), i.e.
-    dense spatial outputs. Global-output models (embedders returning
-    (B, D)) must be fed exact-bucket-sized inputs — zero-padding would
-    silently change a global embedding, so the engine raises instead
-    (embedding workloads resize crops to a fixed size anyway, ref
-    apps/cell-image-search/embedder.py uses fixed 224x224).
+    dense spatial outputs; volumetric models take (B, D, H, W, C) and
+    route through the z-aware bucket/tile path. Global-output models
+    (embedders returning (B, D)) must be fed exact-bucket-sized inputs —
+    zero-padding would silently change a global embedding, so the engine
+    raises instead (embedding workloads resize crops to a fixed size
+    anyway, ref apps/cell-image-search/embedder.py uses fixed 224x224).
+
+    Zero-padding to buckets matches the bioimageio tiling convention but
+    does perturb models whose normalization uses spatially-global
+    statistics (GroupNorm/InstanceNorm): padded zeros enter the moments.
+    Borders are already approximate under tiling; feed exact bucket
+    sizes when bit-faithful outputs matter.
 
     Engine instances are cheap; compiled programs live in the (shared)
     CompiledProgramCache keyed by (model_id, B, H, W, C, dtype).
@@ -60,6 +76,7 @@ class InferenceEngine:
         apply_fn: Callable[[Any, jax.Array], jax.Array],
         params: Any,
         divisor: int = 1,
+        z_divisor: int = 1,
         config: Optional[EngineConfig] = None,
         cache: Optional[CompiledProgramCache] = None,
         device: Optional[jax.Device] = None,
@@ -67,6 +84,7 @@ class InferenceEngine:
         self.model_id = model_id
         self.apply_fn = apply_fn
         self.divisor = divisor
+        self.z_divisor = z_divisor
         self.config = config or EngineConfig()
         self.cache = cache if cache is not None else default_program_cache
         self.device = device or jax.devices()[0]
@@ -74,98 +92,185 @@ class InferenceEngine:
 
     # ---- program management -------------------------------------------------
 
-    def _program(self, batch: int, h: int, w: int, c: int, dtype) -> Callable:
-        key = (self.model_id, batch, h, w, c, np.dtype(dtype).name)
+    def _program(self, shape: tuple[int, ...], dtype) -> Callable:
+        key = (self.model_id, *shape, np.dtype(dtype).name)
 
         def build():
             fn = jax.jit(self.apply_fn)
             # Trigger compilation now so the first request doesn't pay it
             # inside the hot path accounting.
-            dummy = jnp.zeros((batch, h, w, c), dtype)
+            dummy = jnp.zeros(shape, dtype)
             fn(self.params, dummy).block_until_ready()
             return fn
 
         return self.cache.get_or_compile(key, build)
 
-    def warmup(self, shapes: list[tuple[int, int, int, int]], dtype=np.float32):
-        for b, h, w, c in shapes:
-            self._program(b, h, w, c, dtype)
+    def warmup(self, shapes: list[tuple[int, ...]], dtype=np.float32):
+        for shape in shapes:
+            self._program(tuple(shape), dtype)
 
     # ---- prediction ---------------------------------------------------------
 
+    def _axis_specs(self, ndim: int) -> list["_AxisSpec"]:
+        """Per-spatial-axis tiling/bucketing parameters, in axis order.
+
+        4D (B, H, W, C) -> [y, x]; 5D (B, D, H, W, C) -> [z, y, x] with
+        z on its own ladder/tile sizes. One generic code path serves
+        both — planar images are just volumes without a z axis.
+        """
+        cfg = self.config
+        xy = _AxisSpec(
+            cfg.tile, cfg.tile_overlap, cfg.ladder, self.divisor, cfg.max_tile
+        )
+        if ndim == 5:
+            z = _AxisSpec(
+                cfg.tile_z, cfg.tile_overlap_z, cfg.ladder_z,
+                self.z_divisor, cfg.max_tile_z,
+            )
+            return [z, xy, xy]
+        return [xy, xy]
+
     def predict(self, images: np.ndarray) -> np.ndarray:
-        """images: (B, H, W, C) host array -> model output, original size."""
+        """images: (B, H, W, C) or volumes (B, D, H, W, C), host array ->
+        model output, cropped back to the original spatial size. Inputs
+        larger than the per-axis ``max_tile`` run overlap-tiled with
+        linear blend stitching (the reference's blockwise path, ref
+        apps/model-runner/runtime_deployment.py:277-280)."""
         images = np.asarray(images)
-        if images.ndim != 4:
-            raise ValueError(f"expected (B, H, W, C), got {images.shape}")
-        B, H, W, C = images.shape
-        if max(H, W) > self.config.max_tile:
-            return np.stack([self._predict_tiled(img) for img in images])
-        bh, bw = bucket_shape((H, W), self.config.ladder, self.divisor)
+        if images.ndim not in (4, 5):
+            raise ValueError(
+                f"expected (B, H, W, C) or (B, D, H, W, C), got {images.shape}"
+            )
+        specs = self._axis_specs(images.ndim)
+        spatial = images.shape[1:-1]
+        if any(size > spec.max_tile for size, spec in zip(spatial, specs)):
+            return np.stack(
+                [self._predict_tiled(item, specs) for item in images]
+            )
+        return self._predict_direct(images, specs)
+
+    def _predict_direct(self, x: np.ndarray, specs: list["_AxisSpec"]) -> np.ndarray:
+        """Bucket every spatial axis, pad, run the compiled program,
+        crop back."""
+        B = x.shape[0]
+        C = x.shape[-1]
+        spatial = x.shape[1:-1]
+        axes = tuple(range(1, x.ndim - 1))
+        buckets = tuple(
+            bucket_dim(size, spec.ladder, spec.divisor)
+            for size, spec in zip(spatial, specs)
+        )
         bb = bucket_batch(B)
-        x = pad_to(images, (bh, bw))
+        x = pad_to(x, buckets, axes=axes)
         if bb != B:
-            x = np.concatenate([x, np.zeros((bb - B, bh, bw, C), x.dtype)])
-        program = self._program(bb, bh, bw, C, x.dtype)
+            x = np.concatenate(
+                [x, np.zeros((bb - B, *buckets, C), x.dtype)]
+            )
+        program = self._program(x.shape, x.dtype)
         out = np.asarray(program(self.params, jax.device_put(x, self.device)))
         out = out[:B]
-        if out.ndim == 4:
-            out = crop_to(out, (H, W))
-        elif (bh, bw) != (H, W):
+        if out.ndim == len(spatial) + 2:
+            out = crop_to(out, spatial, axes=axes)
+        elif buckets != spatial:
             raise ValueError(
                 f"model '{self.model_id}' returns a global output "
-                f"(shape {out.shape}) but the input {(H, W)} was padded to "
-                f"bucket {(bh, bw)} — padding corrupts global outputs. "
-                f"Resize inputs to a bucket size ({self.config.ladder})."
+                f"(shape {out.shape}) but the input {spatial} was padded to "
+                f"bucket {buckets} — padding corrupts global outputs. "
+                f"Resize inputs to a bucket size."
             )
         return out
 
-    def _predict_tiled(self, image: np.ndarray) -> np.ndarray:
-        """Overlap-tile a single (H, W, C) image; all tiles in one batch.
-
-        Linear-ramp blending in the overlap bands (the reference's
+    def _predict_tiled(
+        self, item: np.ndarray, specs: list["_AxisSpec"]
+    ) -> np.ndarray:
+        """Overlap-tile one (H, W, C) image or (D, H, W, C) stack and
+        stitch with a separable linear ramp (the reference's
         Gaussian-blend stitching, ref apps/fibsem-mito-analysis/
-        analysis_deployment.py:10-14, with a separable ramp).
-        """
-        t, ov = self.config.tile, self.config.tile_overlap
-        H, W, C = image.shape
-        stride = t - ov
-        ys = list(range(0, max(H - ov, 1), stride))
-        xs = list(range(0, max(W - ov, 1), stride))
-        tiles, coords = [], []
-        for y in ys:
-            for x in xs:
-                y0, x0 = min(y, max(H - t, 0)), min(x, max(W - t, 0))
-                tile = image[y0 : y0 + t, x0 : x0 + t]
-                tile = pad_to(tile[None], (t, t))[0]
-                tiles.append(tile)
-                coords.append((y0, x0))
-        batch = np.stack(tiles)
-        out_tiles = self.predict(batch)  # recurses into bucketed path
-        if out_tiles.ndim != 4:
-            raise ValueError(
-                f"tiled prediction requires dense (B, H, W, C) outputs, "
-                f"model '{self.model_id}' returned {out_tiles.shape}"
-            )
-        c_out = out_tiles.shape[-1]
-        acc = np.zeros((H, W, c_out), np.float32)
-        weight = np.zeros((H, W, 1), np.float32)
-        ramp = _blend_ramp(t, ov)
-        for tile_out, (y0, x0) in zip(out_tiles, coords):
-            h = min(t, H - y0)
-            w = min(t, W - x0)
-            acc[y0 : y0 + h, x0 : x0 + w] += (
-                tile_out[:h, :w] * ramp[:h, :w]
-            )
-            weight[y0 : y0 + h, x0 : x0 + w] += ramp[:h, :w]
+        analysis_deployment.py:10-14). Tiles run through the bucketed
+        direct path in chunks of ``tile_batch`` so a large stack never
+        materializes as one giant device batch."""
+        import itertools
+
+        spatial = item.shape[:-1]
+        # clamp tiles to the item (thin stacks) and overlaps to the tile
+        tsizes = [min(s.tile, max(size, 1)) for s, size in zip(specs, spatial)]
+        overlaps = [
+            min(s.overlap, max(t - 1, 0)) for s, t in zip(specs, tsizes)
+        ]
+        starts_per_axis = [
+            _tile_starts(size, t, o)
+            for size, t, o in zip(spatial, tsizes, overlaps)
+        ]
+        coords = list(itertools.product(*starts_per_axis))
+        spatial_axes = tuple(range(1, len(tsizes) + 1))
+
+        def cut(start) -> np.ndarray:
+            sl = tuple(slice(s0, s0 + t) for s0, t in zip(start, tsizes))
+            return pad_to(item[sl][None], tuple(tsizes), axes=spatial_axes)[0]
+
+        # tiles are cut, run, and stitched per chunk (never all at once)
+        # so neither host nor device ever holds more than ``tile_batch``
+        # tiles beyond the accumulator itself
+        chunk = max(int(self.config.tile_batch), 1)
+        ramp = _ramp_nd(tsizes, overlaps)
+        acc = None
+        weight = np.zeros((*spatial, 1), np.float32)
+        for i in range(0, len(coords), chunk):
+            batch = np.stack([cut(s) for s in coords[i : i + chunk]])
+            out = self._predict_direct(batch, specs)
+            if out.ndim != len(spatial) + 2:
+                raise ValueError(
+                    f"tiled prediction requires dense spatial outputs, "
+                    f"model '{self.model_id}' returned {out.shape}"
+                )
+            if acc is None:
+                acc = np.zeros((*spatial, out.shape[-1]), np.float32)
+            for tile_out, start in zip(out, coords[i : i + chunk]):
+                dst = tuple(
+                    slice(s0, min(s0 + t, size))
+                    for s0, t, size in zip(start, tsizes, spatial)
+                )
+                src = tuple(slice(0, s.stop - s.start) for s in dst)
+                acc[dst] += tile_out[src] * ramp[src]
+                weight[dst] += ramp[src]
         return acc / np.maximum(weight, 1e-8)
 
 
-def _blend_ramp(tile: int, overlap: int) -> np.ndarray:
-    """Separable linear ramp (tile, tile, 1), 1.0 in the interior."""
+@dataclasses.dataclass(frozen=True)
+class _AxisSpec:
+    """Tiling/bucketing parameters for one spatial axis."""
+
+    tile: int
+    overlap: int
+    ladder: tuple
+    divisor: int
+    max_tile: int
+
+
+def _tile_starts(size: int, tile: int, overlap: int) -> list[int]:
+    """Start offsets covering [0, size) with ``overlap`` between tiles;
+    the last tile is clamped so it ends exactly at ``size``."""
+    stride = max(tile - overlap, 1)
+    starts = {
+        min(s, max(size - tile, 0))
+        for s in range(0, max(size - overlap, 1), stride)
+    }
+    return sorted(starts)
+
+
+def _ramp_1d(tile: int, overlap: int) -> np.ndarray:
+    """Linear edge ramp of length ``tile``, 1.0 in the interior."""
     r = np.ones(tile, np.float32)
     if overlap > 0:
         edge = np.linspace(1.0 / (overlap + 1), 1.0, overlap, dtype=np.float32)
         r[:overlap] = edge
         r[-overlap:] = edge[::-1]
-    return (r[:, None] * r[None, :])[..., None]
+    return r
+
+
+def _ramp_nd(tiles: list[int], overlaps: list[int]) -> np.ndarray:
+    """Separable blend ramp over N spatial axes, shape (*tiles, 1)."""
+    ramp = np.ones((), np.float32)
+    for t, o in zip(tiles, overlaps):
+        ramp = ramp[..., None] * _ramp_1d(t, o)
+    return ramp[..., None]

@@ -97,33 +97,61 @@ def load_model_rdf(source: str | Path | dict) -> ModelRDF:
 
 # ---- axes conversion --------------------------------------------------------
 
-def to_nhwc(x: np.ndarray, axes: str) -> np.ndarray:
-    """Rearrange an array described by an RDF axes string into (B,H,W,C)."""
-    axes = axes.lower()
+def canonical_layout(axes: str) -> str:
+    """The engine layout for an RDF axes string: volumetric tensors
+    ('z' present) canonicalize to (B, Z, Y, X, C), planar to (B, Y, X, C)."""
+    return "bzyxc" if "z" in axes.lower() else "byxc"
+
+
+def _to_layout(x: np.ndarray, axes: str, layout: str) -> np.ndarray:
+    """Rearrange an array described by ``axes`` into ``layout``, adding
+    singleton dims for layout axes the source doesn't have."""
+    unknown = sorted(set(axes) - set(layout))
+    if unknown:
+        raise ValueError(
+            f"axes '{axes}' contain {unknown} which the TPU runtime does "
+            f"not support (supported layouts: byxc / bzyxc; time or index "
+            f"axes are not implemented)"
+        )
     x = np.asarray(x)
     if x.ndim != len(axes):
         if x.ndim == len(axes) - 1 and "b" in axes:
             x = x[None]
         else:
             raise ValueError(f"array ndim {x.ndim} != axes '{axes}'")
-    order = [axes.index(a) for a in "byxc" if a in axes]
-    missing = [a for a in "byxc" if a not in axes]
+    order = [axes.index(a) for a in layout if a in axes]
+    missing = [a for a in layout if a not in axes]
     x = np.transpose(x, order + [i for i in range(len(axes)) if i not in order])
     for a in missing:
-        x = np.expand_dims(x, "byxc".index(a) if a != "c" else -1)
+        x = np.expand_dims(
+            x, layout.index(a) if a != "c" else -1
+        )
     return x
+
+
+def _from_layout(x: np.ndarray, axes: str, layout: str) -> np.ndarray:
+    """Inverse of _to_layout for the model-output round trip."""
+    present = [a for a in layout if a in axes]
+    # drop axes the target doesn't have (singleton only)
+    for i, a in reversed(list(enumerate(layout))):
+        if a not in axes:
+            x = np.squeeze(x, axis=i if a != "c" else -1)
+    inv = [present.index(a) for a in axes if a in present]
+    return np.transpose(x, inv)
+
+
+def to_nhwc(x: np.ndarray, axes: str) -> np.ndarray:
+    """Rearrange an array described by an RDF axes string into the
+    engine's canonical layout: (B,H,W,C), or (B,Z,H,W,C) when the axes
+    include a z dimension (volumetric models)."""
+    axes = axes.lower()
+    return _to_layout(x, axes, canonical_layout(axes))
 
 
 def from_nhwc(x: np.ndarray, axes: str) -> np.ndarray:
     """Inverse of to_nhwc for the model-output round trip."""
     axes = axes.lower()
-    present = [a for a in "byxc" if a in axes]
-    # drop axes the target doesn't have (singleton only)
-    for i, a in reversed(list(enumerate("byxc"))):
-        if a not in axes:
-            x = np.squeeze(x, axis=i if a != "c" else -1)
-    inv = [present.index(a) for a in axes if a in present]
-    return np.transpose(x, inv)
+    return _from_layout(x, axes, canonical_layout(axes))
 
 
 # ---- pre/post-processing ops ------------------------------------------------

@@ -48,10 +48,16 @@ class TorchFallbackRunner:
             return torch.device("cpu")
 
     def predict(self, images: np.ndarray) -> np.ndarray:
+        """Channels-last in/out; handles (B, H, W, C) images and
+        (B, D, H, W, C) volumes (torch modules are channels-first)."""
         torch = self._torch
-        x = torch.from_numpy(np.ascontiguousarray(images)).permute(0, 3, 1, 2)
+        if images.ndim == 5:
+            to_cf, to_cl = (0, 4, 1, 2, 3), (0, 2, 3, 4, 1)
+        else:
+            to_cf, to_cl = (0, 3, 1, 2), (0, 2, 3, 1)
+        x = torch.from_numpy(np.ascontiguousarray(images)).permute(*to_cf)
         with torch.no_grad():
             y = self.module(x.to(self.device))
         if isinstance(y, (list, tuple)):
             y = y[0]
-        return y.detach().cpu().permute(0, 2, 3, 1).numpy()
+        return y.detach().cpu().permute(*to_cl).numpy()

@@ -136,6 +136,58 @@ def model_collection(tmp_path_factory):
         )
     )
 
+    # tiny-unet3d: volumetric jax_params model (axes bczyx)
+    from bioengine_tpu.models.unet3d import UNet3D
+
+    d4 = root / "tiny-unet3d"
+    d4.mkdir()
+    model3d = UNet3D(features=(2, 4), out_channels=1)
+    # exact bucket sizes (z=8 on the z-ladder, xy=64 on the xy-ladder):
+    # GroupNorm statistics are volume-global, so zero-padding to a
+    # bucket would legitimately change the expected output
+    x3 = (
+        np.random.default_rng(2)
+        .normal(size=(1, 1, 8, 64, 64))
+        .astype(np.float32)
+    )  # bczyx
+    vol = np.transpose(x3, (0, 2, 3, 4, 1))  # engine layout bzyxc
+    params3d = model3d.init(jax.random.key(0), jnp.asarray(vol))["params"]
+    expected3 = np.asarray(
+        jax.jit(lambda p, a: model3d.apply({"params": p}, a))(
+            params3d, jnp.asarray(vol)
+        )
+    )
+    save_params_npz(str(d4 / "weights.npz"), params3d)
+    np.save(d4 / "test_input.npy", x3)
+    np.save(d4 / "test_output.npy", np.transpose(expected3, (0, 4, 1, 2, 3)))
+    (d4 / "rdf.yaml").write_text(
+        yaml.safe_dump(
+            {
+                "type": "model",
+                "name": "Tiny UNet3D",
+                "description": "tiny volumetric segmentation test model",
+                "tags": ["segmentation", "3d"],
+                "inputs": [{"name": "input0", "axes": "bczyx"}],
+                "outputs": [{"name": "output0", "axes": "bczyx"}],
+                "test_inputs": ["test_input.npy"],
+                "test_outputs": ["test_output.npy"],
+                "documentation": "README.md",
+                "weights": {
+                    "jax_params": {
+                        "source": "weights.npz",
+                        "architecture": {
+                            "name": "unet3d",
+                            "kwargs": {
+                                "features": [2, 4],
+                                "out_channels": 1,
+                            },
+                        },
+                    }
+                },
+            }
+        )
+    )
+
     # failed-check model (exists but did not pass inference checks)
     d3 = root / "secret-model"
     d3.mkdir()
@@ -157,6 +209,7 @@ def model_collection(tmp_path_factory):
             {
                 "bioengine_inference": {
                     "tiny-unet": {"status": "passed"},
+                    "tiny-unet3d": {"status": "passed"},
                     "torch-square": {"status": "passed"},
                     "secret-model": {"status": "failed"},
                 }
@@ -186,14 +239,14 @@ class TestModelRunner:
         sid = result["service_id"]
         out = await call(server, sid, "search_models")
         ids = {m["model_id"] for m in out}
-        assert ids == {"tiny-unet", "torch-square"}  # checks filter applied
+        assert ids == {"tiny-unet", "tiny-unet3d", "torch-square"}  # checks filter applied
 
         out = await call(server, sid, "search_models", keywords=["nuclei"])
         assert [m["model_id"] for m in out] == ["tiny-unet"]
 
         out = await call(server, sid, "search_models", ignore_checks=True)
         assert {m["model_id"] for m in out} == {
-            "tiny-unet", "torch-square", "secret-model",
+            "tiny-unet", "tiny-unet3d", "torch-square", "secret-model",
         }
 
     async def test_rdf_and_documentation(self, model_runner):
@@ -248,6 +301,19 @@ class TestModelRunner:
         out = await call(server, sid, "infer", model_id="tiny-unet", inputs=x)
         assert out["_meta"]["backend"] == "xla"
         np.testing.assert_allclose(out["output0"], expected, rtol=1e-4, atol=1e-4)
+
+    async def test_infer_volumetric_jax_model(self, model_runner, model_collection):
+        # 3D family end to end: bczyx axes -> engine volume path -> back
+        result, server = model_runner
+        sid = result["service_id"]
+        x = np.load(model_collection / "tiny-unet3d" / "test_input.npy")
+        expected = np.load(model_collection / "tiny-unet3d" / "test_output.npy")
+        out = await call(server, sid, "infer", model_id="tiny-unet3d", inputs=x)
+        assert out["_meta"]["backend"] == "xla"
+        assert np.asarray(out["output0"]).shape == expected.shape
+        np.testing.assert_allclose(
+            out["output0"], expected, rtol=1e-4, atol=1e-4
+        )
 
     async def test_infer_torch_fallback(self, model_runner):
         result, server = model_runner

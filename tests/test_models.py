@@ -144,3 +144,27 @@ def test_cellpose_sam_in_registry():
     assert "cellpose-sam" in list_models()
     m = get_model("cellpose-sam", patch_size=4, dim=64, depth=1, num_heads=4)
     assert m.patch_size == 4
+
+
+def test_unet3d_shapes_isotropic():
+    model = get_model("unet3d", features=(4, 8), out_channels=2)
+    assert model.divisor == 2
+    assert model.z_divisor == 2
+    x = jnp.zeros((1, 8, 16, 16, 1))
+    params = model.init(jax.random.key(0), x)["params"]
+    y = model.apply({"params": params}, x)
+    assert y.shape == (1, 8, 16, 16, 2)
+    assert y.dtype == jnp.float32
+
+
+def test_unet3d_anisotropic_z_strides():
+    # classic anisotropic recipe: keep z resolution at the first level
+    model = get_model("unet3d", features=(4, 8, 16), z_strides=(1, 2))
+    assert model.divisor == 4
+    assert model.z_divisor == 2
+    x = jnp.zeros((1, 4, 16, 16, 1))
+    params = model.init(jax.random.key(0), x)["params"]
+    y = model.apply({"params": params}, x)
+    assert y.shape == (1, 4, 16, 16, 1)
+    with pytest.raises(ValueError, match="z_strides"):
+        _ = get_model("unet3d", features=(4, 8, 16), z_strides=(1,)).z_divisor

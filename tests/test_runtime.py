@@ -141,6 +141,94 @@ class TestEngine:
         assert out.shape == x.shape
         np.testing.assert_allclose(out, x + 1.0, rtol=1e-4, atol=1e-5)
 
+    def test_volume_bucketed_predict(self, engine):
+        # 5D input routes through the volumetric path; odd sizes pad to
+        # the z/xy buckets and crop back
+        x = np.random.rand(1, 5, 50, 70, 2).astype(np.float32)
+        out = engine.predict(x)
+        assert out.shape == x.shape
+        np.testing.assert_allclose(out, 2 * x, rtol=1e-5)
+
+    def test_volume_tiled_matches_direct(self):
+        def apply_fn(params, x):
+            return x * 3.0
+
+        cfg = EngineConfig(
+            max_tile=32, tile=24, tile_overlap=8,
+            max_tile_z=8, tile_z=6, tile_overlap_z=2,
+            ladder_z=(2, 4, 6, 8),
+        )
+        eng = InferenceEngine(
+            "times3-3d", apply_fn, {}, config=cfg,
+            cache=CompiledProgramCache(),
+        )
+        x = np.random.rand(1, 13, 40, 50, 1).astype(np.float32)
+        out = eng.predict(x)
+        assert out.shape == x.shape
+        np.testing.assert_allclose(out, 3 * x, rtol=1e-4, atol=1e-5)
+
+    def test_thin_wide_stack_clamps_z_overlap(self):
+        # D smaller than tile_overlap_z: the z tile clamps to D and the
+        # overlap clamps below the tile instead of crashing the ramp
+        def apply_fn(params, x):
+            return x + 2.0
+
+        cfg = EngineConfig(
+            max_tile=32, tile=24, tile_overlap=8,
+            max_tile_z=16, tile_z=12, tile_overlap_z=8,
+        )
+        eng = InferenceEngine(
+            "plus2-thin", apply_fn, {}, config=cfg,
+            cache=CompiledProgramCache(),
+        )
+        x = np.random.rand(1, 4, 60, 40, 1).astype(np.float32)
+        out = eng.predict(x)
+        assert out.shape == x.shape
+        np.testing.assert_allclose(out, x + 2.0, rtol=1e-4, atol=1e-5)
+
+    def test_tiled_chunks_bound_device_batch(self):
+        # tile_batch=2 forces multiple chunks; stitching must still be
+        # exact and the largest compiled batch must stay at the chunk cap
+        def apply_fn(params, x):
+            return x * 2.0
+
+        cfg = EngineConfig(
+            max_tile=16, tile=16, tile_overlap=4, tile_batch=2,
+            ladder=(16,),
+        )
+        cache = CompiledProgramCache()
+        eng = InferenceEngine(
+            "times2-chunk", apply_fn, {}, config=cfg, cache=cache
+        )
+        x = np.random.rand(1, 50, 50, 1).astype(np.float32)
+        out = eng.predict(x)
+        np.testing.assert_allclose(out, x * 2.0, rtol=1e-4, atol=1e-5)
+        batches = {key[1] for key in cache._programs}  # (model, B, ...)
+        assert max(batches) <= 2, batches
+
+    def test_volume_respects_z_divisor(self):
+        """A real 3D conv model: padding must land on the pooling
+        divisor in every axis or the forward would shape-error."""
+        import jax
+
+        from bioengine_tpu.models.unet3d import UNet3D
+
+        model = UNet3D(features=(2, 4), out_channels=1)
+        x = np.random.rand(1, 6, 20, 24, 1).astype(np.float32)
+        params = model.init(jax.random.key(0), jnp.zeros((1, 8, 32, 32, 1)))[
+            "params"
+        ]
+        eng = InferenceEngine(
+            "unet3d-test",
+            lambda p, a: model.apply({"params": p}, a),
+            params,
+            divisor=model.divisor,
+            z_divisor=model.z_divisor,
+            cache=CompiledProgramCache(),
+        )
+        out = eng.predict(x)
+        assert out.shape == (1, 6, 20, 24, 1)
+
 
 class TestConvert:
     def test_conv_kernel_layout(self):
@@ -232,6 +320,28 @@ class TestRDF:
         assert nhwc.shape == (2, 10, 12, 3)
         back = from_nhwc(nhwc, "bcyx")
         np.testing.assert_array_equal(back, x)
+
+    def test_volumetric_axes_roundtrip(self):
+        from bioengine_tpu.runtime.rdf import canonical_layout
+
+        assert canonical_layout("bczyx") == "bzyxc"
+        assert canonical_layout("byxc") == "byxc"
+        x = np.random.rand(2, 3, 5, 10, 12).astype(np.float32)  # bczyx
+        vol = to_nhwc(x, "bczyx")
+        assert vol.shape == (2, 5, 10, 12, 3)
+        back = from_nhwc(vol, "bczyx")
+        np.testing.assert_array_equal(back, x)
+        # batchless 0.4-style volume: zyx gains batch + channel dims
+        y = np.random.rand(4, 6, 8).astype(np.float32)
+        vol = to_nhwc(y, "bzyx")  # implicit batch from ndim mismatch
+        assert vol.shape == (1, 4, 6, 8, 1)
+
+    def test_unsupported_axes_rejected_loudly(self):
+        # a time axis must not be silently misrouted into the
+        # volumetric path as if it were z
+        x = np.zeros((1, 3, 2, 8, 9), np.float32)
+        with pytest.raises(ValueError, match="not support"):
+            to_nhwc(x, "btcyx")
 
     def test_axes_dict_form(self):
         from bioengine_tpu.runtime.rdf import _axes_string
