@@ -22,13 +22,15 @@ DEFAULT_LADDER = (64, 128, 192, 256, 384, 512, 768, 1024, 1536, 2048)
 def bucket_dim(size: int, ladder: Sequence[int] = DEFAULT_LADDER, divisor: int = 1) -> int:
     """Smallest ladder entry >= size that is divisible by ``divisor``.
 
-    Falls back to rounding up to the next multiple of max(divisor, 128)
-    above the ladder.
+    Off-ladder fallback: the next multiple of ``divisor``, aligned to
+    128 when ``divisor`` divides 128 (MXU-friendly) — the result is
+    always divisible by ``divisor`` so pooled model shapes stay whole,
+    even for divisors (e.g. 5) that divide no ladder entry.
     """
     for b in ladder:
         if b >= size and b % divisor == 0:
             return b
-    step = max(divisor, 128)
+    step = 128 if divisor <= 128 and 128 % divisor == 0 else divisor
     return math.ceil(size / step) * step
 
 

@@ -39,6 +39,15 @@ class TestBuckets:
     def test_bucket_dim_divisor(self):
         assert bucket_dim(100, divisor=8) % 8 == 0
 
+    def test_bucket_fallback_respects_odd_divisor(self):
+        # divisor 5 divides no ladder entry: the fallback must still
+        # return a multiple of 5 (a 16x pad explosion — or a downstream
+        # shape error — otherwise)
+        assert bucket_dim(8, (8, 16, 24, 32), 5) == 10
+        assert bucket_dim(101, (8, 16), 5) == 105
+        # power-of-two divisors keep the 128 alignment above the ladder
+        assert bucket_dim(3000, (64, 128), 2) == 3072
+
     def test_bucket_above_ladder(self):
         assert bucket_dim(5000) >= 5000
 
