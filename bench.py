@@ -40,7 +40,7 @@ Env overrides:
   BENCH_PLATFORM=cpu    run on host CPU (tiny shapes, not a real number)
   BENCH_ATTEMPTS=N      subprocess attempts (default 3)
   BENCH_TIMEOUT=N       per-attempt seconds (default 1500)
-  BENCH_CONFIGS=a,b,c   subset of vit,unet,cellpose
+  BENCH_CONFIGS=a,b,c   subset of vit,unet,unet3d,cellpose,search
   BENCH_PROFILE=dir     capture a jax.profiler trace of one rep per config
 """
 
@@ -53,6 +53,10 @@ import sys
 import time
 
 BASELINE_VIT_IMG_PER_SEC = 500.0  # ref cell-image-search/README.md:122 (1x A100)
+
+# single source of the stage set — the worker dict, both BENCH_CONFIGS
+# defaults, and the help text all derive from this
+DEFAULT_CONFIGS = ("vit", "unet", "unet3d", "cellpose", "search")
 
 # ---------------------------------------------------------------------------
 # Worker: runs in a subprocess, prints one JSON line per stage on stdout.
@@ -156,6 +160,40 @@ def _bench_unet(cpu: bool) -> dict:
 
     best = _timed_scan(jax.jit(chained), params, tiles)
     return {"images_per_sec": round(batch * iters / best, 2), "batch": batch}
+
+
+def _bench_unet3d(cpu: bool) -> dict:
+    """Volumetric family throughput: UNet3D on a 32x256x256 stack (the
+    engine's direct bucketed path — one jitted forward per volume)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bioengine_tpu.models.unet3d import UNet3D
+
+    if cpu:
+        depth, hw, iters, feats = 4, 32, 2, (4, 8)
+    else:
+        depth, hw, iters, feats = 32, 256, 10, (16, 32, 64)
+    model = UNet3D(features=feats, out_channels=1)
+    vol = jnp.zeros((1, depth, hw, hw, 1), jnp.float32)
+    params = model.init(jax.random.key(0), vol)["params"]
+
+    def chained(params, vol):
+        def step(carry, _):
+            x = vol + carry * jnp.float32(1e-6)
+            out = model.apply({"params": params}, x)
+            return jnp.mean(out).astype(jnp.float32), None
+
+        carry, _ = jax.lax.scan(step, jnp.float32(0.0), None, length=iters)
+        return carry
+
+    best = _timed_scan(jax.jit(chained), params, vol)
+    voxels = depth * hw * hw
+    return {
+        "volumes_per_sec": round(iters / best, 3),
+        "mvoxels_per_sec": round(iters * voxels / best / 1e6, 1),
+        "shape": [depth, hw, hw],
+    }
 
 
 def _bench_cellpose(cpu: bool) -> dict:
@@ -290,13 +328,14 @@ def worker_main() -> int:
     configs = {
         "vit": _bench_vit,
         "unet": _bench_unet,
+        "unet3d": _bench_unet3d,
         "cellpose": _bench_cellpose,
         "search": _bench_search,
     }
     wanted = [
         n.strip()
         for n in os.environ.get(
-            "BENCH_CONFIGS", "vit,unet,cellpose,search"
+            "BENCH_CONFIGS", ",".join(DEFAULT_CONFIGS)
         ).split(",")
     ]
     any_fail = False
@@ -364,7 +403,9 @@ def main() -> int:
     for attempt in range(1, attempts + 1):
         remaining = [
             s.strip()
-            for s in os.environ.get("BENCH_CONFIGS", "vit,unet,cellpose,search").split(",")
+            for s in os.environ.get(
+                "BENCH_CONFIGS", ",".join(DEFAULT_CONFIGS)
+            ).split(",")
             if s.strip() and not stages.get(s.strip(), {}).get("ok")
         ]
         if attempt > 1 and not remaining:
@@ -448,6 +489,7 @@ def main() -> int:
     extra = {
         "probe": stages.get("probe"),
         "unet256": stages.get("unet"),
+        "unet3d": stages.get("unet3d"),
         "search_latency": stages.get("search"),
         "cellpose_finetune": stages.get("cellpose"),
         "attempts": len(diagnostics) + (1 if value else 0),

@@ -7,9 +7,11 @@ bioimageio blockwise prediction does the same in-process. Neither is
 parallel. Here the first spatial axis — image height, or stack depth
 for volumetric (B, D, H, W, C) models — is sharded over the mesh's
 ``sp`` axis and convolutional halos are exchanged with ``ppermute``
-over ICI: one jitted program, N chips, no stitching artifacts (exact,
-not blended: every output pixel sees the same receptive field as the
-unsharded model).
+over ICI: one jitted program, N chips, no stitching artifacts. With
+halo >= receptive radius the interior is bit-exact vs the unsharded
+model; multi-layer models differ only within the receptive radius of
+the GLOBAL borders, where block-level zero padding stands in for the
+unsharded model's per-layer padding (see ``spatial_shard_apply``).
 """
 
 from __future__ import annotations

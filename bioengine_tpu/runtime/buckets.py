@@ -22,16 +22,20 @@ DEFAULT_LADDER = (64, 128, 192, 256, 384, 512, 768, 1024, 1536, 2048)
 def bucket_dim(size: int, ladder: Sequence[int] = DEFAULT_LADDER, divisor: int = 1) -> int:
     """Smallest ladder entry >= size that is divisible by ``divisor``.
 
-    Off-ladder fallback: the next multiple of ``divisor``, aligned to
-    128 when ``divisor`` divides 128 (MXU-friendly) — the result is
-    always divisible by ``divisor`` so pooled model shapes stay whole,
-    even for divisors (e.g. 5) that divide no ladder entry.
+    Off-ladder fallback — always divisible by ``divisor`` so pooled
+    model shapes stay whole, while keeping the compilation count
+    bounded (the module's purpose): 128-steps when ``divisor`` divides
+    128 (MXU-friendly), else geometric quantization to
+    divisor * 2^k (log-many buckets, <2x padding) for divisors like 5
+    that divide no ladder entry.
     """
     for b in ladder:
         if b >= size and b % divisor == 0:
             return b
-    step = 128 if divisor <= 128 and 128 % divisor == 0 else divisor
-    return math.ceil(size / step) * step
+    if divisor <= 128 and 128 % divisor == 0:
+        return math.ceil(size / 128) * 128
+    units = math.ceil(size / divisor)
+    return divisor * (1 << max(0, math.ceil(math.log2(units))))
 
 
 def bucket_shape(

@@ -41,10 +41,11 @@ class TestBuckets:
 
     def test_bucket_fallback_respects_odd_divisor(self):
         # divisor 5 divides no ladder entry: the fallback must still
-        # return a multiple of 5 (a 16x pad explosion — or a downstream
-        # shape error — otherwise)
+        # return a multiple of 5 (a downstream shape error otherwise),
+        # quantized geometrically so compilations stay bounded
         assert bucket_dim(8, (8, 16, 24, 32), 5) == 10
-        assert bucket_dim(101, (8, 16), 5) == 105
+        assert bucket_dim(101, (8, 16), 5) == 160  # 5 * 2^5
+        assert bucket_dim(106, (8, 16), 5) == 160  # same bucket, no recompile
         # power-of-two divisors keep the 128 alignment above the ladder
         assert bucket_dim(3000, (64, 128), 2) == 3072
 
