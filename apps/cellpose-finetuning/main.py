@@ -571,12 +571,20 @@ class CellposeFinetune:
             "snapshot": session.snapshots()[-1] if session.snapshots() else None,
         }
 
-    def _infer(self, session, images, cellprob_threshold, min_size):
+    def _load_snapshot(self, session):
+        from bioengine_tpu.runtime.convert import load_params_npz
+
+        return load_params_npz(str(session.latest_path))
+
+    def _predict_raw(self, session, x: np.ndarray, params=None) -> np.ndarray:
+        """(N, H, W, 2) prepared batch -> (N, H, W, 3) raw network
+        output (dy, dx, cellprob logits). ``params`` preloaded via
+        ``_load_snapshot`` keeps multi-pass callers (infer_3d's three
+        orientations) on ONE snapshot even while training is writing
+        new ones; None loads the latest."""
         import jax
 
-        from bioengine_tpu.ops.flows import predictions_to_masks
         from bioengine_tpu.runtime.buckets import bucket_shape, crop_to, pad_to
-        from bioengine_tpu.runtime.convert import load_params_npz
 
         cfg = session.config
         model, divisor = build_model(cfg)
@@ -594,18 +602,90 @@ class CellposeFinetune:
                 lambda p, a, m=model: m.apply({"params": p}, a)
             )
         fwd = self._fwd_cache[arch_key]
-        params = load_params_npz(str(session.latest_path))
-        x = self._prepare_images(images)
+        if params is None:
+            params = self._load_snapshot(session)
         H, W = x.shape[1:3]
         bh, bw = bucket_shape((H, W), divisor=divisor)
         pred = np.asarray(fwd(params, pad_to(x, (bh, bw))))
-        pred = crop_to(pred, (H, W))
+        return crop_to(pred, (H, W))
+
+    def _infer(self, session, images, cellprob_threshold, min_size):
+        from bioengine_tpu.ops.flows import predictions_to_masks
+
+        pred = self._predict_raw(session, self._prepare_images(images))
         return [
             predictions_to_masks(
                 p, cellprob_threshold=cellprob_threshold, min_size=min_size
             )
             for p in pred
         ]
+
+    @schema_method
+    async def infer_3d(
+        self,
+        session_id: str,
+        volumes: list,
+        cellprob_threshold: float = 0.0,
+        min_size: int = 15,
+        context=None,
+    ):
+        """Segment (D, H, W) grayscale volumes with the session's 2D
+        model via the cellpose ``do_3D`` recipe: the network runs over
+        yx, zx, and zy slice orientations, shared flow components are
+        averaged into one (dz, dy, dx) field, and voxels are followed
+        to 3D sinks (ops/flows.py). The reference delegates this to the
+        upstream cellpose library; here it is first-class and the flow
+        following runs jitted on TPU."""
+        session = self._get_session(session_id)
+        if not session.latest_path.exists():
+            raise RuntimeError(f"session '{session_id}' has no snapshot yet")
+        masks = await asyncio.to_thread(
+            self._infer_3d, session, volumes, cellprob_threshold, min_size
+        )
+        return {
+            "masks": masks,
+            "n_cells": [int(m.max()) for m in masks],
+            "snapshot": session.snapshots()[-1] if session.snapshots() else None,
+        }
+
+    def _infer_3d(self, session, volumes, cellprob_threshold, min_size):
+        from bioengine_tpu.ops.flows import (
+            FLOW_SCALE,
+            aggregate_orthogonal_flows,
+            masks_from_flows,
+        )
+
+        # one snapshot for the whole request: the three orientation
+        # passes must not mix weights when training is concurrently
+        # writing new epochs
+        params = self._load_snapshot(session)
+        out = []
+        for vol in volumes:
+            v = np.array(vol, np.float32, copy=True)
+            if v.ndim != 3:
+                raise ValueError(
+                    f"infer_3d expects (D, H, W) grayscale volumes, "
+                    f"got shape {v.shape}"
+                )
+            # normalize the whole volume once — per-slice percentile
+            # normalization would flicker along the slicing axis
+            lo, hi = np.percentile(v, [1, 99])
+            v = (v - lo) / max(hi - lo, 1e-6)
+            preds = []
+            for axes in ((0, 1, 2), (1, 0, 2), (2, 0, 1)):  # yx, zx, zy
+                slices = np.ascontiguousarray(np.transpose(v, axes))
+                x = np.stack([slices, np.zeros_like(slices)], axis=-1)
+                preds.append(self._predict_raw(session, x, params=params))
+            flow, cellprob = aggregate_orthogonal_flows(*preds)
+            out.append(
+                masks_from_flows(
+                    flow / FLOW_SCALE,
+                    cellprob,
+                    cellprob_threshold=cellprob_threshold,
+                    min_size=min_size,
+                )
+            )
+        return out
 
     @schema_method
     async def export_model(

@@ -131,7 +131,9 @@ def cellpose_loss(pred: jax.Array, flows: jax.Array, cellprob: jax.Array):
     pred: (B, H, W, 3); flows: (B, H, W, 2) target flow field in [-1, 1];
     cellprob: (B, H, W) binary target.
     """
-    flow_loss = 0.5 * jnp.mean((pred[..., :2] - 5.0 * flows) ** 2)
+    from bioengine_tpu.ops.flows import FLOW_SCALE
+
+    flow_loss = 0.5 * jnp.mean((pred[..., :2] - FLOW_SCALE * flows) ** 2)
     bce = optax.sigmoid_binary_cross_entropy(pred[..., 2], cellprob)
     return flow_loss + jnp.mean(bce), {
         "flow_loss": flow_loss,

@@ -8,12 +8,15 @@ Here the ops are first-class:
 - ``masks_to_flows``  — host-side (numpy/scipy) training-target generation:
   per-instance heat diffusion from the cell center, flows = normalized
   gradient of the heat map.
-- ``follow_flows``    — device-side (JAX) Euler integration of pixel
-  positions through the predicted flow field via ``lax.scan`` — static
-  iteration count, bilinear gather, runs fused on TPU right after the
-  network forward pass.
-- ``masks_from_flows`` — host-side clustering of converged pixel sinks
-  into instance labels.
+- ``follow_flows`` / ``follow_flows_3d`` — device-side (JAX) Euler
+  integration of pixel/voxel positions through the predicted flow field
+  via ``lax.scan`` — static iteration count, bi-/trilinear gather, runs
+  fused on TPU right after the network forward pass.
+- ``masks_from_flows`` — host-side clustering of converged sinks into
+  instance labels; dimension-agnostic (2D images and 3D volumes).
+- ``aggregate_orthogonal_flows`` — the cellpose ``do_3D`` recipe:
+  2D-network outputs over yx/zx/zy slice orientations -> one 3D flow
+  field.
 """
 
 from __future__ import annotations
@@ -22,6 +25,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from scipy import ndimage
+
+# Training targets scale unit-norm flows by this factor (see
+# bioengine_tpu.models.cellpose.cellpose_loss); raw network flow output
+# must be divided by it before Euler integration.
+FLOW_SCALE = 5.0
 
 
 def masks_to_flows(masks: np.ndarray, n_iter: int | None = None) -> np.ndarray:
@@ -113,6 +121,87 @@ def follow_flows(
     return p_final.reshape(2, H, W)
 
 
+def _trilinear_sample(field: jax.Array, p: jax.Array) -> jax.Array:
+    """Sample (D, H, W) ``field`` at float positions p=(3, N), clamped."""
+    D, H, W = field.shape
+    z = jnp.clip(p[0], 0.0, D - 1.0)
+    y = jnp.clip(p[1], 0.0, H - 1.0)
+    x = jnp.clip(p[2], 0.0, W - 1.0)
+    z0 = jnp.floor(z).astype(jnp.int32)
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    z1 = jnp.minimum(z0 + 1, D - 1)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    wz, wy, wx = z - z0, y - y0, x - x0
+    out = 0.0
+    for zi, wzi in ((z0, 1 - wz), (z1, wz)):
+        for yi, wyi in ((y0, 1 - wy), (y1, wy)):
+            for xi, wxi in ((x0, 1 - wx), (x1, wx)):
+                out = out + field[zi, yi, xi] * wzi * wyi * wxi
+    return out
+
+
+def follow_flows_3d(
+    flow: jax.Array, n_iter: int = 200, step: float = 1.0
+) -> jax.Array:
+    """Integrate every voxel through a (3, D, H, W) flow field (dz, dy,
+    dx) on device. Returns final positions (3, D, H, W). Same
+    ``lax.scan`` structure as the 2D ``follow_flows``."""
+    D, H, W = flow.shape[1:]
+    zz, yy, xx = jnp.meshgrid(
+        jnp.arange(D, dtype=jnp.float32),
+        jnp.arange(H, dtype=jnp.float32),
+        jnp.arange(W, dtype=jnp.float32),
+        indexing="ij",
+    )
+    p0 = jnp.stack([zz.ravel(), yy.ravel(), xx.ravel()])  # (3, D*H*W)
+    limits = jnp.array([[D - 1.0], [H - 1.0], [W - 1.0]], jnp.float32)
+
+    def body(p, _):
+        dp = jnp.stack([_trilinear_sample(flow[i], p) for i in range(3)])
+        p = jnp.clip(p + step * dp, 0.0, limits)
+        return p, None
+
+    p_final, _ = jax.lax.scan(body, p0, None, length=n_iter)
+    return p_final.reshape(3, D, H, W)
+
+
+def aggregate_orthogonal_flows(
+    pred_yx: np.ndarray, pred_zx: np.ndarray, pred_zy: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Combine per-orientation 2D network outputs over a (D, H, W)
+    volume into a 3D flow field — the cellpose ``do_3D`` recipe (the
+    upstream library runs its 2D net on yx/zx/zy slices and averages
+    the shared flow components; the reference delegates to it).
+
+    pred_yx: (D, H, W, 3) — z-slices:  channels (dy, dx, cellprob)
+    pred_zx: (H, D, W, 3) — y-slices:  channels (dz, dx, cellprob)
+    pred_zy: (W, D, H, 3) — x-slices:  channels (dz, dy, cellprob)
+
+    Returns (flow (3, D, H, W) in (dz, dy, dx) order, cellprob (D, H, W));
+    each flow component is the mean of its two contributing orientations,
+    cellprob the mean of all three.
+    """
+    yx = np.asarray(pred_yx, np.float32)                     # [z, y, x, c]
+    zx = np.transpose(np.asarray(pred_zx, np.float32), (1, 0, 2, 3))  # [z, y, x, c]
+    zy = np.transpose(np.asarray(pred_zy, np.float32), (1, 2, 0, 3))  # [z, y, x, c]
+    if not (yx.shape == zx.shape == zy.shape):
+        raise ValueError(
+            f"orientation outputs disagree after realignment: "
+            f"{yx.shape} vs {zx.shape} vs {zy.shape}"
+        )
+    flow = np.stack(
+        [
+            (zx[..., 0] + zy[..., 0]) / 2.0,   # dz
+            (yx[..., 0] + zy[..., 1]) / 2.0,   # dy
+            (yx[..., 1] + zx[..., 1]) / 2.0,   # dx
+        ]
+    )
+    cellprob = (yx[..., 2] + zx[..., 2] + zy[..., 2]) / 3.0
+    return flow, cellprob
+
+
 def predictions_to_masks(
     pred: np.ndarray,
     cellprob_threshold: float = 0.0,
@@ -126,7 +215,7 @@ def predictions_to_masks(
     rescaled by 1/5 here before flow-following — without this, Euler
     steps overshoot ~5 px and sinks scatter instead of converging.
     """
-    flow = np.moveaxis(pred[..., :2], -1, 0) / 5.0
+    flow = np.moveaxis(pred[..., :2], -1, 0) / FLOW_SCALE
     return masks_from_flows(
         flow,
         pred[..., 2],
@@ -145,21 +234,26 @@ def masks_from_flows(
 ) -> np.ndarray:
     """Postprocess *unit-scale* flows + cellprob logits -> instance labels.
 
-    For raw network output use ``predictions_to_masks`` (handles the 5x
-    training-target scale)."""
+    flow (2, H, W) + cellprob (H, W) for planar data, or (3, D, H, W) +
+    (D, H, W) for volumes — the sink-cluster recipe (scipy ndimage) is
+    dimension-agnostic. For raw network output use
+    ``predictions_to_masks`` (handles the 5x training-target scale)."""
     fg = cellprob > cellprob_threshold
     if not fg.any():
         return np.zeros_like(cellprob, dtype=np.int32)
-    p = np.asarray(follow_flows(jnp.asarray(flow), n_iter=n_iter))
-    H, W = cellprob.shape
-    sinks = np.zeros((H, W), bool)
-    py = np.clip(np.round(p[0][fg]).astype(int), 0, H - 1)
-    px = np.clip(np.round(p[1][fg]).astype(int), 0, W - 1)
-    sinks[py, px] = True
+    follow = follow_flows if flow.shape[0] == 2 else follow_flows_3d
+    p = np.asarray(follow(jnp.asarray(flow), n_iter=n_iter))
+    spatial = cellprob.shape
+    sinks = np.zeros(spatial, bool)
+    idx = tuple(
+        np.clip(np.round(p[d][fg]).astype(int), 0, spatial[d] - 1)
+        for d in range(len(spatial))
+    )
+    sinks[idx] = True
     # Dilate sinks so nearby convergence points merge into one seed blob.
     seed_labels, _ = ndimage.label(ndimage.binary_dilation(sinks, iterations=2))
-    masks = np.zeros((H, W), np.int32)
-    masks[fg] = seed_labels[py, px]
+    masks = np.zeros(spatial, np.int32)
+    masks[fg] = seed_labels[idx]
     # Remove speckle instances.
     labels, counts = np.unique(masks[masks > 0], return_counts=True)
     small = set(labels[counts < min_size].tolist())

@@ -532,6 +532,42 @@ class TestCellposeFinetune:
         pred = pipeline.predict(x)["output0"]
         assert pred.shape == (1, 64, 64, 3)
 
+    async def test_infer_3d_do3d_recipe(self, cellpose_app):
+        """Volumetric segmentation via the do_3D recipe: the 2D model
+        runs over three slice orientations and voxels follow the
+        aggregated 3D flow field."""
+        result, server = cellpose_app
+        sid = result["service_id"]
+        images, masks = _synthetic_cells()
+        await call(
+            server, sid, "start_training",
+            train_images=images, train_labels=masks, config=FAST_CFG,
+            session_id="session-3d",
+        )
+        final = await wait_for_status(
+            server, sid, "session-3d", {"completed", "failed"}
+        )
+        assert final["status"] == "completed", final.get("error")
+
+        # a bright cube in a dim volume — shape checks, not accuracy
+        # (FAST_CFG trains 2 epochs on synthetic blobs)
+        vol = np.full((8, 32, 32), 0.1, np.float32)
+        vol[2:6, 10:22, 10:22] = 1.0
+        out = await call(
+            server, sid, "infer_3d", session_id="session-3d",
+            volumes=[vol.tolist()],
+        )
+        m = np.asarray(out["masks"][0])
+        assert m.shape == (8, 32, 32)
+        assert m.dtype.kind in "iu"
+        assert out["n_cells"] == [int(m.max())]
+
+        with pytest.raises(Exception, match="grayscale volumes"):
+            await call(
+                server, sid, "infer_3d", session_id="session-3d",
+                volumes=[np.zeros((4, 4)).tolist()],
+            )
+
     async def test_stop_and_restart(self, cellpose_app):
         result, server = cellpose_app
         sid = result["service_id"]
