@@ -627,20 +627,27 @@ class CellposeFinetune:
         volumes: list,
         cellprob_threshold: float = 0.0,
         min_size: int = 15,
+        anisotropy: float = 1.0,
         context=None,
     ):
         """Segment (D, H, W) grayscale volumes with the session's 2D
         model via the cellpose ``do_3D`` recipe: the network runs over
         yx, zx, and zy slice orientations, shared flow components are
         averaged into one (dz, dy, dx) field, and voxels are followed
-        to 3D sinks (ops/flows.py). The reference delegates this to the
+        to 3D sinks (ops/flows.py). ``anisotropy`` = z-spacing /
+        xy-spacing: the stack is resampled along z by this factor first
+        so cells appear isotropic to the 2D network, and the masks are
+        resampled back. The reference delegates all of this to the
         upstream cellpose library; here it is first-class and the flow
         following runs jitted on TPU."""
         session = self._get_session(session_id)
         if not session.latest_path.exists():
             raise RuntimeError(f"session '{session_id}' has no snapshot yet")
+        if anisotropy <= 0:
+            raise ValueError(f"anisotropy must be positive, got {anisotropy}")
         masks = await asyncio.to_thread(
-            self._infer_3d, session, volumes, cellprob_threshold, min_size
+            self._infer_3d, session, volumes, cellprob_threshold, min_size,
+            anisotropy,
         )
         return {
             "masks": masks,
@@ -648,10 +655,15 @@ class CellposeFinetune:
             "snapshot": session.snapshots()[-1] if session.snapshots() else None,
         }
 
-    def _infer_3d(self, session, volumes, cellprob_threshold, min_size):
+    def _infer_3d(
+        self, session, volumes, cellprob_threshold, min_size, anisotropy=1.0
+    ):
+        from scipy import ndimage as ndi
+
         from bioengine_tpu.ops.flows import (
             FLOW_SCALE,
             aggregate_orthogonal_flows,
+            filter_and_relabel,
             masks_from_flows,
         )
 
@@ -667,6 +679,10 @@ class CellposeFinetune:
                     f"infer_3d expects (D, H, W) grayscale volumes, "
                     f"got shape {v.shape}"
                 )
+            orig_depth = v.shape[0]
+            if anisotropy != 1.0:
+                # make voxels isotropic for the 2D net's zx/zy passes
+                v = ndi.zoom(v, (anisotropy, 1.0, 1.0), order=1)
             # normalize the whole volume once — per-slice percentile
             # normalization would flicker along the slicing axis
             lo, hi = np.percentile(v, [1, 99])
@@ -677,14 +693,33 @@ class CellposeFinetune:
                 x = np.stack([slices, np.zeros_like(slices)], axis=-1)
                 preds.append(self._predict_raw(session, x, params=params))
             flow, cellprob = aggregate_orthogonal_flows(*preds)
-            out.append(
-                masks_from_flows(
-                    flow / FLOW_SCALE,
-                    cellprob,
-                    cellprob_threshold=cellprob_threshold,
-                    min_size=min_size,
-                )
+            # min_size is a caller-resolution voxel count: at the
+            # z-resampled resolution it scales by the anisotropy factor,
+            # and the authoritative filter runs after resampling back
+            masks = masks_from_flows(
+                flow / FLOW_SCALE,
+                cellprob,
+                cellprob_threshold=cellprob_threshold,
+                min_size=max(1, int(round(min_size * anisotropy))),
             )
+            if masks.shape[0] != orig_depth:
+                # nearest-neighbour back to the caller's z sampling —
+                # labels must not be interpolated
+                masks = ndi.zoom(
+                    masks, (orig_depth / masks.shape[0], 1.0, 1.0), order=0
+                )
+                masks = masks[:orig_depth]
+                if masks.shape[0] < orig_depth:
+                    masks = np.pad(
+                        masks,
+                        ((0, orig_depth - masks.shape[0]), (0, 0), (0, 0)),
+                        mode="edge",
+                    )
+                # resampling can erase whole instances: re-filter and
+                # re-label at the caller's resolution so n_cells ==
+                # masks.max() stays truthful
+                masks = filter_and_relabel(masks, min_size)
+            out.append(masks)
         return out
 
     @schema_method

@@ -254,12 +254,18 @@ def masks_from_flows(
     seed_labels, _ = ndimage.label(ndimage.binary_dilation(sinks, iterations=2))
     masks = np.zeros(spatial, np.int32)
     masks[fg] = seed_labels[idx]
-    # Remove speckle instances.
+    return filter_and_relabel(masks, min_size)
+
+
+def filter_and_relabel(masks: np.ndarray, min_size: int) -> np.ndarray:
+    """Drop instances smaller than ``min_size`` pixels/voxels and
+    re-label the rest densely 1..N. Re-run after any resampling of a
+    label image: resampling can erase instances, leaving id gaps that
+    make ``masks.max()`` lie about the cell count."""
     labels, counts = np.unique(masks[masks > 0], return_counts=True)
     small = set(labels[counts < min_size].tolist())
     if small:
-        masks[np.isin(masks, list(small))] = 0
-    # Re-label densely.
+        masks = np.where(np.isin(masks, list(small)), 0, masks)
     out = np.zeros_like(masks)
     for i, lbl in enumerate(np.unique(masks[masks > 0]), start=1):
         out[masks == lbl] = i

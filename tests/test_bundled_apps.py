@@ -562,10 +562,23 @@ class TestCellposeFinetune:
         assert m.dtype.kind in "iu"
         assert out["n_cells"] == [int(m.max())]
 
+        # anisotropic stacks resample along z and come back at the
+        # caller's original depth
+        out = await call(
+            server, sid, "infer_3d", session_id="session-3d",
+            volumes=[vol.tolist()], anisotropy=2.0,
+        )
+        assert np.asarray(out["masks"][0]).shape == (8, 32, 32)
+
         with pytest.raises(Exception, match="grayscale volumes"):
             await call(
                 server, sid, "infer_3d", session_id="session-3d",
                 volumes=[np.zeros((4, 4)).tolist()],
+            )
+        with pytest.raises(Exception, match="anisotropy"):
+            await call(
+                server, sid, "infer_3d", session_id="session-3d",
+                volumes=[vol.tolist()], anisotropy=0.0,
             )
 
     async def test_stop_and_restart(self, cellpose_app):
