@@ -681,8 +681,15 @@ class CellposeFinetune:
                 )
             orig_depth = v.shape[0]
             if anisotropy != 1.0:
-                # make voxels isotropic for the 2D net's zx/zy passes
-                v = ndi.zoom(v, (anisotropy, 1.0, 1.0), order=1)
+                # make voxels isotropic for the 2D net's zx/zy passes;
+                # the explicit factor guarantees >= 1 output plane for
+                # tiny anisotropy values
+                new_depth = max(1, int(round(orig_depth * anisotropy)))
+                v = ndi.zoom(v, (new_depth / orig_depth, 1.0, 1.0), order=1)
+            # actual resampling ratio (rounding can make it differ from
+            # the requested anisotropy, including a no-op) — min_size
+            # scales by this, not by the raw parameter
+            depth_ratio = v.shape[0] / orig_depth
             # normalize the whole volume once — per-slice percentile
             # normalization would flicker along the slicing axis
             lo, hi = np.percentile(v, [1, 99])
@@ -694,13 +701,14 @@ class CellposeFinetune:
                 preds.append(self._predict_raw(session, x, params=params))
             flow, cellprob = aggregate_orthogonal_flows(*preds)
             # min_size is a caller-resolution voxel count: at the
-            # z-resampled resolution it scales by the anisotropy factor,
-            # and the authoritative filter runs after resampling back
+            # z-resampled resolution it scales by the actual depth
+            # ratio, and the authoritative filter runs after resampling
+            # back
             masks = masks_from_flows(
                 flow / FLOW_SCALE,
                 cellprob,
                 cellprob_threshold=cellprob_threshold,
-                min_size=max(1, int(round(min_size * anisotropy))),
+                min_size=max(1, int(round(min_size * depth_ratio))),
             )
             if masks.shape[0] != orig_depth:
                 # nearest-neighbour back to the caller's z sampling —

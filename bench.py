@@ -234,9 +234,15 @@ def _bench_cellpose(cpu: bool) -> dict:
 def _bench_search(cpu: bool) -> dict:
     """TPU index query latency vs the reference's FAISS-CPU baselines:
     FlatIP <5 ms at 100K vectors, IVFFlat <20 ms at 1M
-    (ref apps/cell-image-search/README.md:132-133). Per-query wall time
-    includes host->device transfer of the query and the result fetch —
-    the app's real serving path (apps/cell-image-search/index.py)."""
+    (ref apps/cell-image-search/README.md:132-133).
+
+    Corpus = unit-norm gaussian blobs around cluster centers (real
+    embedding corpora are clustered; on UNstructured random data the
+    IVF probe selection hits unrepresentatively tiny lists). Two
+    numbers per index: single-query p50 (includes the per-execution
+    completion latency of the serving path — on a tunneled dev device
+    that fixed cost dominates) and batch-64 amortized per-query
+    latency (the index's real throughput)."""
     import importlib.util
 
     import numpy as np
@@ -252,35 +258,50 @@ def _bench_search(cpu: bool) -> dict:
     spec.loader.exec_module(mod)
 
     rng = np.random.default_rng(0)
-    # flat matches the reference's "<100K vectors, <5 ms" row exactly;
-    # the IVF corpus is kept at 200K because its BUILD path (CPU
-    # k-means) is not what's being measured — per-query latency is
-    # corpus-size-insensitive once lists are probed (nprobe bounded)
     n_flat, n_ivf = (2000, 10000) if cpu else (100_000, 200_000)
     dim = 768
+
+    def blob_corpus(n, n_centers):
+        centers = rng.standard_normal((n_centers, dim)).astype(np.float32)
+        pts = centers[rng.integers(0, n_centers, n)] + 0.3 * (
+            rng.standard_normal((n, dim)).astype(np.float32)
+        )
+        return pts / np.linalg.norm(pts, axis=1, keepdims=True)
+
+    corpus_flat = blob_corpus(n_flat, 64)
+    corpus_ivf = blob_corpus(n_ivf, 128 if not cpu else 16)
     out = {}
-    for label, index in (
-        ("flat_100k", mod.FlatIPIndex(
-            rng.standard_normal((n_flat, dim), dtype=np.float32)
-        )),
+    for label, index, corpus in (
+        ("flat_100k", mod.FlatIPIndex(corpus_flat), corpus_flat),
         ("ivfflat_200k", mod.IVFFlatIndex.build(
-            rng.standard_normal((n_ivf, dim), dtype=np.float32),
+            corpus_ivf,
             nlist=128 if not cpu else 16,
             n_init=1,  # build cost is not the metric; query latency is
-        )),
+        ), corpus_ivf),
     ):
-        q = rng.normal(size=(1, dim)).astype(np.float32)
-        index.search(q, 10)  # warmup: device upload + compile
-        times = []
+        # queries drawn near corpus points: realistic probe selectivity
+        q1 = corpus[:1] + 0.05 * rng.standard_normal((1, dim)).astype(np.float32)
+        qb = corpus[:64] + 0.05 * rng.standard_normal((64, dim)).astype(np.float32)
+        index.search(q1, 10)  # warmup: device upload + compile
+        index.search(qb, 10)
+        singles, batches = [], []
         for _ in range(20):
             t0 = time.perf_counter()
-            index.search(q, 10)
-            times.append(time.perf_counter() - t0)
-        times.sort()
+            index.search(q1, 10)
+            singles.append(time.perf_counter() - t0)
+        for _ in range(5):
+            t0 = time.perf_counter()
+            index.search(qb, 10)
+            batches.append(time.perf_counter() - t0)
+        singles.sort()
+        batches.sort()
         out[label] = {
             "n_vectors": index.ntotal,
-            "p50_ms": round(1000 * times[len(times) // 2], 3),
-            "best_ms": round(1000 * times[0], 3),
+            "p50_ms": round(1000 * singles[len(singles) // 2], 3),
+            "best_ms": round(1000 * singles[0], 3),
+            "batch64_per_query_ms": round(
+                1000 * batches[len(batches) // 2] / 64, 4
+            ),
         }
     return out
 

@@ -570,6 +570,13 @@ class TestCellposeFinetune:
         )
         assert np.asarray(out["masks"][0]).shape == (8, 32, 32)
 
+        # extreme downsampling clamps to >= 1 plane instead of crashing
+        out = await call(
+            server, sid, "infer_3d", session_id="session-3d",
+            volumes=[vol.tolist()], anisotropy=0.05,
+        )
+        assert np.asarray(out["masks"][0]).shape == (8, 32, 32)
+
         with pytest.raises(Exception, match="grayscale volumes"):
             await call(
                 server, sid, "infer_3d", session_id="session-3d",
