@@ -286,21 +286,22 @@ class IVFPQIndex:
         all_s, all_i = [], []
         for row, plist in enumerate(probes):
             qr = q[row]
+            # ADC table from q itself: x_hat = c + r_hat, so
+            # q·x_hat = q·c + q·r_hat — the table scores q against
+            # the residual codebooks (FAISS IP-by-residual does the
+            # same; building it from q - c would add a spurious
+            # -c·r_hat ranking term). Probe-independent: built once
+            # per query, not per probed list.
+            lut = np.einsum(
+                "mkd,md->mk",
+                self.codebooks,
+                qr.reshape(self.M, self.dsub),
+            )  # (M, KSUB)
             parts_s, parts_i = [], []
             for p in plist:
                 s0, s1 = self.list_bounds[p]
                 if s1 <= s0:
                     continue
-                # ADC table from q itself: x_hat = c + r_hat, so
-                # q·x_hat = q·c + q·r_hat — the table scores q against
-                # the residual codebooks (FAISS IP-by-residual does the
-                # same; building it from q - c would add a spurious
-                # -c·r_hat ranking term)
-                lut = np.einsum(
-                    "mkd,md->mk",
-                    self.codebooks,
-                    qr.reshape(self.M, self.dsub),
-                )  # (M, KSUB)
                 codes = self.codes[s0:s1]  # (L, M)
                 scores = lut[np.arange(self.M)[None, :], codes].sum(axis=1)
                 scores = scores + float(qr @ self.centroids[p])

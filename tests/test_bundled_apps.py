@@ -802,6 +802,9 @@ class TestCellposeFrontend:
             assert "Cellpose Fine-Tuning" in text
             # the page derives the service id from its own URL
             assert "/apps/" in text and "/call/" in text
+            # interactive annotation (the reference UI's core workflow)
+            assert 'data-tab="annotate"' in text
+            assert "addToTrainingSet" in text
             # path escape is rejected
             async with http.get(
                 f"{base}/apps/{result['app_id']}/..%2f..%2fmanifest.yaml"
