@@ -64,6 +64,13 @@ def _cellpose_sam(**kw) -> nn.Module:
     return CellposeSAM(**kw)
 
 
+@register_model("stardist2d")
+def _stardist2d(**kw) -> nn.Module:
+    from bioengine_tpu.models.stardist import StarDist2D
+
+    return StarDist2D(**kw)
+
+
 @register_model("vit-b14")
 def _vit_b14(**kw) -> nn.Module:
     from bioengine_tpu.models.vit import ViT

@@ -1,0 +1,58 @@
+"""StarDist-style star-convex instance segmentation model.
+
+StarDist (Schmidt et al., MICCAI 2018) is, alongside cellpose, the
+standard nuclei-segmentation family in the BioImage Model Zoo; the
+reference serves zoo StarDist models through its torch/tensorflow
+runtime (ref apps/model-runner/runtime_deployment.py:234-312). This is
+the TPU-native family member: a UNet2D backbone with two heads —
+per-pixel object probability and ``n_rays`` radial boundary distances —
+trained/served in bf16 on the MXU. Polygon reconstruction (NMS +
+rendering) lives in ``bioengine_tpu.ops.stardist``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from bioengine_tpu.models.unet import ConvBlock
+
+
+class StarDist2D(nn.Module):
+    """in: (B, H, W, C_in); out: (B, H, W, 1 + n_rays) — channel 0 is
+    the object-probability logit, channels 1..n_rays are ray distances
+    (softplus-activated, in pixels)."""
+
+    n_rays: int = 32
+    features: Sequence[int] = (32, 64, 128)
+    in_channels: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        skips = []
+        for feats in self.features[:-1]:
+            x = ConvBlock(feats, self.dtype)(x)
+            skips.append(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = ConvBlock(self.features[-1], self.dtype)(x)
+        for feats, skip in zip(
+            reversed(self.features[:-1]), reversed(skips)
+        ):
+            x = nn.ConvTranspose(
+                feats, (2, 2), strides=(2, 2), dtype=self.dtype
+            )(x)
+            x = jnp.concatenate([x, skip], axis=-1)
+            x = ConvBlock(feats, self.dtype)(x)
+        prob = nn.Conv(1, (1, 1), dtype=jnp.float32, name="prob_head")(x)
+        dist = nn.Conv(
+            self.n_rays, (1, 1), dtype=jnp.float32, name="dist_head"
+        )(x)
+        return jnp.concatenate([prob, nn.softplus(dist)], axis=-1)
+
+    @property
+    def divisor(self) -> int:
+        return 2 ** (len(self.features) - 1)
