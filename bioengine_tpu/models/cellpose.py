@@ -141,8 +141,11 @@ def cellpose_loss(pred: jax.Array, flows: jax.Array, cellprob: jax.Array):
     }
 
 
-def make_train_step(dp_axis: str | None = None):
-    """Build a pure train step ``(state, images, flows, cellprob) -> (state, metrics)``.
+def make_loss_train_step(loss_call, dp_axis: str | None = None):
+    """Build a pure train step ``(state, images, *targets) ->
+    (state, metrics)`` for any ``loss_call(pred, *targets) ->
+    (loss, metrics)`` — the shared mechanics (value_and_grad, optional
+    psum-averaging, apply_gradients) for every model family.
 
     If ``dp_axis`` is given, the step is written for use inside
     ``shard_map``/pjit over that mesh axis: gradients are ``psum``-averaged
@@ -151,10 +154,10 @@ def make_train_step(dp_axis: str | None = None):
     automatically — pass ``dp_axis=None`` then.
     """
 
-    def step(state: TrainState, images, flows, cellprob):
+    def step(state: TrainState, images, *targets):
         def loss_fn(params):
             pred = state.apply_fn({"params": params}, images)
-            return cellpose_loss(pred, flows, cellprob)
+            return loss_call(pred, *targets)
 
         (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params
@@ -168,6 +171,12 @@ def make_train_step(dp_axis: str | None = None):
         return state, metrics
 
     return step
+
+
+def make_train_step(dp_axis: str | None = None):
+    """Cellpose train step ``(state, images, flows, cellprob) ->
+    (state, metrics)`` (see ``make_loss_train_step``)."""
+    return make_loss_train_step(cellpose_loss, dp_axis)
 
 
 @dataclasses.dataclass(frozen=True)

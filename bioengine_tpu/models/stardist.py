@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Sequence
 
 import jax.numpy as jnp
+import optax
 from flax import linen as nn
 
 from bioengine_tpu.models.unet import ConvBlock
@@ -56,3 +57,34 @@ class StarDist2D(nn.Module):
     @property
     def divisor(self) -> int:
         return 2 ** (len(self.features) - 1)
+
+
+def stardist_loss(
+    pred: jnp.ndarray,
+    prob: jnp.ndarray,
+    dist: jnp.ndarray,
+    dist_weight: float = 0.2,
+):
+    """StarDist objective (upstream recipe): BCE on the object
+    probability + object-masked MAE on ray distances (background rays
+    carry no signal and would swamp the regression).
+
+    pred: (B, H, W, 1 + n_rays) network output; prob: (B, H, W) binary
+    targets; dist: (B, H, W, n_rays) target ray distances in pixels.
+    Consumed by ``make_stardist_train_step``.
+    """
+    bce = jnp.mean(optax.sigmoid_binary_cross_entropy(pred[..., 0], prob))
+    mask = prob[..., None]
+    mae = jnp.sum(jnp.abs(pred[..., 1:] - dist) * mask) / (
+        jnp.sum(mask) * dist.shape[-1] + 1e-6
+    )
+    return bce + dist_weight * mae, {"bce_loss": bce, "dist_loss": mae}
+
+
+def make_stardist_train_step(dp_axis: str | None = None):
+    """StarDist train step ``(state, images, prob, dist) ->
+    (state, metrics)`` over ``cellpose.TrainState`` — built on the
+    shared ``cellpose.make_loss_train_step`` mechanics."""
+    from bioengine_tpu.models.cellpose import make_loss_train_step
+
+    return make_loss_train_step(stardist_loss, dp_axis)
