@@ -205,6 +205,12 @@ class CellposeFinetune:
         reference recovers sessions from disk the same way; training
         tasks do not survive, so running ones become 'interrupted')."""
         for d in self.sessions_root.iterdir():
+            if d.name.startswith("."):
+                # a '.{name}.deleting-*' dir is a failed start_training's
+                # renamed-away tree whose threaded rmtree didn't finish
+                # (crash/restart mid-delete) — sweep it, never adopt it
+                shutil.rmtree(d, ignore_errors=True)
+                continue
             if (d / "status.json").exists():
                 try:
                     cfg = json.loads((d / "config.json").read_text())
@@ -451,8 +457,22 @@ class CellposeFinetune:
         except BaseException:
             self.sessions.pop(session_id, None)
             # don't leave a half-initialized dir for _recover_sessions
-            # to re-adopt as a ghost session after a restart
-            shutil.rmtree(session.dir, ignore_errors=True)
+            # to re-adopt as a ghost session after a restart. Rename
+            # synchronously (atomic, cheap) so a concurrent retry of the
+            # same id never races the delete of a live path, then delete
+            # the renamed tree in a thread so a large half-written data
+            # dir can't stall the event loop
+            doomed = session.dir.with_name(
+                f".{session.dir.name}.deleting-{uuid.uuid4().hex[:8]}"
+            )
+            try:
+                session.dir.rename(doomed)
+            except OSError:
+                doomed = None
+            if doomed is not None:
+                await asyncio.to_thread(
+                    shutil.rmtree, doomed, ignore_errors=True
+                )
             raise
         finally:
             session.preparing = False
@@ -562,9 +582,14 @@ class CellposeFinetune:
             raise RuntimeError(
                 f"session '{session_id}' has no snapshot yet"
             )
-        masks = await asyncio.to_thread(
-            self._infer, session, images, cellprob_threshold, min_size
-        )
+        try:
+            masks = await asyncio.to_thread(
+                self._infer, session, images, cellprob_threshold, min_size
+            )
+        except FileNotFoundError as exc:
+            # an in-flight call can race delete_session's threaded rmtree
+            # after the id is deregistered — surface a clean error
+            raise RuntimeError(f"session '{session_id}' was deleted") from exc
         return {
             "masks": masks,
             "n_cells": [int(m.max()) for m in masks],
@@ -645,10 +670,14 @@ class CellposeFinetune:
             raise RuntimeError(f"session '{session_id}' has no snapshot yet")
         if anisotropy <= 0:
             raise ValueError(f"anisotropy must be positive, got {anisotropy}")
-        masks = await asyncio.to_thread(
-            self._infer_3d, session, volumes, cellprob_threshold, min_size,
-            anisotropy,
-        )
+        try:
+            masks = await asyncio.to_thread(
+                self._infer_3d, session, volumes, cellprob_threshold,
+                min_size, anisotropy,
+            )
+        except FileNotFoundError as exc:
+            # same delete_session race as ``infer``
+            raise RuntimeError(f"session '{session_id}' was deleted") from exc
         return {
             "masks": masks,
             "n_cells": [int(m.max()) for m in masks],

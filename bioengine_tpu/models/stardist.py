@@ -66,11 +66,14 @@ def stardist_loss(
     dist_weight: float = 0.2,
 ):
     """StarDist objective (upstream recipe): BCE on the object
-    probability + object-masked MAE on ray distances (background rays
-    carry no signal and would swamp the regression).
+    probability + prob-weighted MAE on ray distances (background rays
+    carry no signal and would swamp the regression; weighting by the
+    edt target emphasizes rays measured from near the medial axis,
+    matching upstream).
 
-    pred: (B, H, W, 1 + n_rays) network output; prob: (B, H, W) binary
-    targets; dist: (B, H, W, n_rays) target ray distances in pixels.
+    pred: (B, H, W, 1 + n_rays) network output; prob: (B, H, W)
+    edt-normalized targets in [0, 1] (``ops.stardist.masks_to_stardist``);
+    dist: (B, H, W, n_rays) target ray distances in pixels.
     Consumed by ``make_stardist_train_step``.
     """
     bce = jnp.mean(optax.sigmoid_binary_cross_entropy(pred[..., 0], prob))

@@ -14,6 +14,8 @@ first-class numpy ops (host-side post/pre-processing around the jitted
 
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 
 
@@ -26,15 +28,35 @@ def masks_to_stardist(
 ) -> tuple[np.ndarray, np.ndarray]:
     """Instance labels (H, W) int -> (prob (H, W), dist (H, W, n_rays)).
 
-    prob is the binary object map (upstream uses a normalized distance
-    transform; the binary map trains the same thresholded NMS pipeline).
+    prob is the per-instance edt-normalized distance transform (the
+    upstream StarDist recipe): 1.0 on each instance's medial axis,
+    falling to ~0 at its boundary. A model trained on this target peaks
+    at object centers, so the greedy prob-ordered NMS in
+    ``polygons_to_masks`` picks medial-axis pixels as polygon centers
+    instead of arbitrary interior ones.
     dist[y, x, r] = steps along ray r until the label under the ray
     differs from the label at (y, x), capped at ``max_dist``.
     """
+    from scipy import ndimage
+
     H, W = masks.shape
     yy, xx = np.mgrid[:H, :W]
     dist = np.zeros((H, W, n_rays), np.float32)
     inside = masks > 0
+    prob = np.zeros((H, W), np.float32)
+    # find_objects gets every bounding box in ONE image pass; the
+    # per-label work below is then proportional to box area, not H*W
+    for lbl, slc in enumerate(ndimage.find_objects(masks), start=1):
+        if slc is None:
+            continue
+        box = masks[slc] == lbl
+        # pad so instance pixels touching the crop edge still measure a
+        # distance-to-background; edt is per-instance so touching
+        # neighbours form a boundary (a global edt would merge them)
+        d = ndimage.distance_transform_edt(np.pad(box, 1))[1:-1, 1:-1]
+        peak = d.max()
+        if peak > 0:
+            prob[slc][box] = (d / peak)[box].astype(np.float32)
     for r, ang in enumerate(ray_angles(n_rays)):
         dy, dx = np.sin(ang), np.cos(ang)
         still = inside.copy()
@@ -50,7 +72,7 @@ def masks_to_stardist(
             still = same
             if not still.any():
                 break
-    return inside.astype(np.float32), dist
+    return prob, dist
 
 
 def _render_polygon(
@@ -107,8 +129,42 @@ def polygons_to_masks(
     cand = np.argwhere(prob > prob_threshold)
     if len(cand) == 0:
         return np.zeros((H, W), np.int32)
+    if len(cand) > max_candidates:
+        # subsample SPATIALLY (per-cell argmax on a stride grid sized to
+        # the budget) rather than by a global prob cutoff: a global
+        # top-k drops every candidate of any cell whose peak prob falls
+        # below the k-th pixel, silently losing whole instances on
+        # large dense images. A grid keeps one (locally best) candidate
+        # per neighbourhood everywhere — the same idea as upstream
+        # StarDist's ``grid`` candidate subsampling.
+        n_orig = len(cand)
+        p = prob[cand[:, 0], cand[:, 1]]
+        stride = max(2, int(np.ceil(np.sqrt(n_orig / max_candidates))))
+        n_cols = (W + stride - 1) // stride
+        cell = (cand[:, 0] // stride) * n_cols + cand[:, 1] // stride
+        by_cell = np.lexsort((-p, cell))
+        # within-cell rank by prob: every cell's best candidate outranks
+        # ANY cell's second-best, so truncating to the budget keeps one
+        # locally-max candidate per neighbourhood everywhere before
+        # spending budget on runners-up — no instance loses its peak
+        # unless there are more occupied cells than budget
+        c_sorted = cell[by_cell]
+        is_first = np.ones(n_orig, bool)
+        is_first[1:] = c_sorted[1:] != c_sorted[:-1]
+        first = np.maximum.accumulate(
+            np.where(is_first, np.arange(n_orig), 0)
+        )
+        rank = np.arange(n_orig) - first
+        final = np.lexsort((-p[by_cell], rank))[:max_candidates]
+        cand = cand[by_cell[final]]
+        warnings.warn(
+            f"polygons_to_masks: {n_orig} candidates exceeded "
+            f"max_candidates={max_candidates}; grid-subsampled "
+            f"(stride {stride}) to {len(cand)}",
+            stacklevel=2,
+        )
     order = np.argsort(-prob[cand[:, 0], cand[:, 1]], kind="stable")
-    cand = cand[order[:max_candidates]]
+    cand = cand[order]
     canvas = np.zeros((H, W), np.int32)
     label = 0
     for cy, cx in cand:

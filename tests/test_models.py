@@ -230,6 +230,32 @@ def test_stardist_border_cells_not_suppressed():
     assert iou > 0.6, iou
 
 
+def test_stardist_candidate_overflow_grid_subsamples():
+    """When candidates exceed max_candidates, subsampling must be
+    SPATIAL (per-grid-cell argmax), not a global prob top-k — every
+    instance keeps a candidate, so none are silently dropped (ADVICE
+    r4: global truncation lost low-peak cells on dense images)."""
+    import warnings
+
+    from bioengine_tpu.ops.stardist import masks_to_stardist, polygons_to_masks
+
+    masks = np.zeros((96, 96), np.int32)
+    yy, xx = np.mgrid[:96, :96]
+    lbl = 0
+    for cy in range(8, 96, 16):
+        for cx in range(8, 96, 16):
+            lbl += 1
+            masks[(yy - cy) ** 2 + (xx - cx) ** 2 < 36] = lbl
+    prob, dist = masks_to_stardist(masks, n_rays=16)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        rec = polygons_to_masks(
+            prob, dist, prob_threshold=0.1, max_candidates=50
+        )
+    assert any("grid-subsampled" in str(w.message) for w in caught)
+    assert rec.max() == lbl, f"lost instances: {rec.max()} of {lbl}"
+
+
 def test_stardist_empty_and_logit_paths():
     from bioengine_tpu.ops.stardist import (
         polygons_to_masks,
