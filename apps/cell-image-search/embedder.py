@@ -12,9 +12,11 @@ batch 64, ~500 img/s on one A100) with the framework's Flax ViT:
   multi-GPU path was aspirational, SURVEY.md §6).
 
 Pretrained DINOv2 weights convert from the torch checkpoint via
-``bioengine_tpu.runtime.convert`` when a weights file is supplied;
-without one the model runs randomly initialized (deterministic seed),
-which preserves the full pipeline shape for tests and benchmarks.
+``bioengine_tpu.runtime.convert`` — one-time:
+``bioengine models convert dinov2_vitb14.pth weights.npz --arch dinov2``
+— then pass the npz as ``weights_path``. Without one the model runs
+randomly initialized (deterministic seed), which preserves the full
+pipeline shape for tests and benchmarks.
 """
 
 from __future__ import annotations

@@ -26,6 +26,7 @@ import shutil
 import time
 import uuid
 from pathlib import Path
+from typing import Optional
 
 import numpy as np
 import yaml
@@ -37,14 +38,20 @@ _TERMINAL_STATES = ("completed", "failed", "stopped", "interrupted")
 
 DEFAULT_CONFIG = {
     # "unet" = CellposeNet (residual U-Net); "sam" = CellposeSAM, the
-    # transformer-backbone family member matching the reference's
-    # Cellpose-SAM fine-tuning target (models/cellpose_sam.py)
+    # transformer-backbone family member (models/cellpose_sam.py);
+    # "cpsam" = models/sam.CpSAM, the faithful pretrained Cellpose-SAM
+    # architecture (SAM ViT encoder + readout) — set "pretrained_path"
+    # to a converted checkpoint (runtime.convert.convert_checkpoint /
+    # `bioengine models convert --arch cpsam`) to fine-tune from the
+    # foundation weights like the reference does
+    # (ref apps/cellpose-finetuning/main.py:2248, model_type="cpsam")
     "backbone": "unet",
     "features": [32, 64, 128, 256],      # unet backbone
-    "patch_size": 8,                      # sam backbone
+    "patch_size": 8,                      # sam/cpsam backbones
     "dim": 256,
     "depth": 8,
     "num_heads": 8,
+    "pretrained_path": None,              # flat-npz jax_params to start from
     "learning_rate": 1e-4,
     "weight_decay": 1e-5,
     "epochs": 10,
@@ -53,12 +60,103 @@ DEFAULT_CONFIG = {
     "seed": 0,
 }
 
+# cpsam-only architecture knobs, overridable in config; the defaults in
+# models/sam.py are the ViT-L checkpoint shape
+_CPSAM_KEYS = (
+    "window_size", "global_attn_indexes", "neck_dim", "pretrain_grid",
+    "mlp_ratio",
+)
+
+
+# the pretrained cpsam checkpoint shape (ViT-L @ patch 8). When the
+# user selects backbone "cpsam" these beat DEFAULT_CONFIG's small
+# unet/sam sizes — otherwise the documented minimal config
+# {"backbone": "cpsam", "pretrained_path": ...} would silently build a
+# dim-256/depth-8 model and reject every real checkpoint.
+_CPSAM_ARCH_DEFAULTS = {
+    "patch_size": 8, "dim": 1024, "depth": 24, "num_heads": 16,
+    "tile": 256,
+}
+
+
+def _merge_config(config: Optional[dict]) -> dict:
+    config = dict(config or {})
+    cfg = {**DEFAULT_CONFIG, **config}
+    if cfg.get("backbone") == "cpsam":
+        for k, v in _CPSAM_ARCH_DEFAULTS.items():
+            if k not in config:
+                cfg[k] = v
+    return cfg
+
+
+def _model_channels(cfg: dict) -> int:
+    """cpsam is a 3-channel model (its pretrained patch embedding is
+    3-channel); the app's prepared batches are [cyto, nucleus] and get
+    a zero third channel at the model boundary."""
+    return 3 if cfg.get("backbone") == "cpsam" else 2
+
+
+def _to_model_channels(x: np.ndarray, cfg: dict) -> np.ndarray:
+    c = _model_channels(cfg)
+    if x.shape[-1] == c:
+        return x
+    pad = np.zeros((*x.shape[:-1], c - x.shape[-1]), x.dtype)
+    return np.concatenate([x, pad], axis=-1)
+
+
+def _flat_shapes(tree: dict, prefix: str = "") -> dict:
+    out = {}
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            out.update(_flat_shapes(v, f"{prefix}{k}/"))
+        else:
+            out[f"{prefix}{k}"] = tuple(v.shape)
+    return out
+
+
+def _check_pretrained_tree(params: dict, expect: dict) -> None:
+    """Loud structural validation of a pretrained checkpoint against the
+    configured architecture: missing/unexpected leaves and shape
+    mismatches name themselves instead of failing deep inside jit.
+    Position/rel-pos tables are declared at their checkpoint extent
+    (``pretrain_grid``/``window_size`` config) and resized at apply, so
+    exact shape equality is the correct check for every leaf."""
+    got, want = _flat_shapes(params), _flat_shapes(expect)
+    missing = sorted(set(want) - set(got))
+    extra = sorted(set(got) - set(want))
+    bad = [
+        f"{k}: checkpoint {got[k]} vs model {want[k]}"
+        for k in sorted(set(got) & set(want))
+        if got[k] != want[k]
+    ]
+    if missing or extra or bad:
+        raise ValueError(
+            "pretrained_path does not match the configured architecture: "
+            f"missing={missing[:5]} unexpected={extra[:5]} "
+            f"shape_mismatch={bad[:5]}"
+        )
+
 
 def build_model(cfg: dict):
     """(model, divisor) for the configured backbone — both emit the same
     (B, H, W, 3) flow/cellprob logits, so the train step, loss, flows
     postprocessing, and export path are backbone-agnostic."""
-    if cfg.get("backbone", "unet") == "sam":
+    backbone = cfg.get("backbone", "unet")
+    if backbone == "cpsam":
+        from bioengine_tpu.models.sam import CpSAM
+
+        kw = {k: cfg[k] for k in _CPSAM_KEYS if k in cfg}
+        if "global_attn_indexes" in kw:
+            kw["global_attn_indexes"] = tuple(kw["global_attn_indexes"])
+        model = CpSAM(
+            patch_size=int(cfg.get("patch_size", 8)),
+            dim=int(cfg.get("dim", 1024)),
+            depth=int(cfg.get("depth", 24)),
+            num_heads=int(cfg.get("num_heads", 16)),
+            **kw,
+        )
+        return model, model.divisor
+    if backbone == "sam":
         from bioengine_tpu.models.cellpose_sam import CellposeSAM
 
         model = CellposeSAM(
@@ -78,7 +176,21 @@ def build_model(cfg: dict):
 def _arch_entry(cfg: dict) -> dict:
     """rdf.yaml architecture stanza for the configured backbone — the
     registry name + kwargs the model-runner uses to rebuild it."""
-    if cfg.get("backbone", "unet") == "sam":
+    backbone = cfg.get("backbone", "unet")
+    if backbone == "cpsam":
+        kw = {
+            "patch_size": int(cfg.get("patch_size", 8)),
+            "dim": int(cfg.get("dim", 1024)),
+            "depth": int(cfg.get("depth", 24)),
+            "num_heads": int(cfg.get("num_heads", 16)),
+        }
+        for k in _CPSAM_KEYS:
+            if k in cfg:
+                kw[k] = (
+                    list(cfg[k]) if k == "global_attn_indexes" else cfg[k]
+                )
+        return {"name": "cpsam", "kwargs": kw}
+    if backbone == "sam":
         return {
             "name": "cellpose-sam",
             "kwargs": {
@@ -326,10 +438,26 @@ class CellposeFinetune:
                 restored_state = serialization.from_bytes(
                     template, session.train_state_path.read_bytes()
                 )
+        elif cfg.get("pretrained_path"):
+            # fine-tune from converted foundation weights (the
+            # reference's whole value proposition: start from cpsam,
+            # ref main.py:2248) — validate the tree against the
+            # architecture cheaply via eval_shape so a wrong checkpoint
+            # fails loudly naming the mismatched leaves, not deep in jit
+            params = load_params_npz(cfg["pretrained_path"])
+            expect = jax.eval_shape(
+                lambda: model.init(
+                    jax.random.key(0),
+                    jnp.zeros(
+                        (1, tile, tile, _model_channels(cfg)), jnp.float32
+                    ),
+                )
+            )["params"]
+            _check_pretrained_tree(params, expect)
         else:
             params = model.init(
                 jax.random.key(cfg["seed"]),
-                jnp.zeros((1, tile, tile, 2), jnp.float32),
+                jnp.zeros((1, tile, tile, _model_channels(cfg)), jnp.float32),
             )["params"]
         state = replicate(
             mesh,
@@ -356,7 +484,7 @@ class CellposeFinetune:
                     im, cp = im[::-1], cp[::-1]
                     fl = fl[::-1] * np.array([-1.0, 1.0], np.float32)
                 bi[j], bf[j], bc[j] = im, fl, cp
-            return bi, bf, bc
+            return _to_model_channels(bi, cfg), bf, bc
 
         steps_per_epoch = max(1, n * max(H // tile, 1) * max(W // tile, 1) // batch)
         session.write_status(
@@ -422,7 +550,7 @@ class CellposeFinetune:
         or (H, W, C) arrays; ``train_labels``: instance-label masks of
         the same spatial shape. Returns the session id to poll with
         ``get_training_status``."""
-        cfg = {**DEFAULT_CONFIG, **(config or {})}
+        cfg = _merge_config(config)
         session_id = session_id or f"session-{uuid.uuid4().hex[:8]}"
         async with self._lifecycle_lock(session_id):
             existing = self.sessions.get(session_id)
@@ -621,6 +749,14 @@ class CellposeFinetune:
             tuple(cfg["features"]),
             cfg.get("patch_size"), cfg.get("dim"),
             cfg.get("depth"), cfg.get("num_heads"),
+            # cpsam-only knobs change the architecture too — without
+            # them two cpsam sessions differing only in e.g.
+            # window_size would share one compiled model
+            *(
+                tuple(cfg[k]) if isinstance(cfg.get(k), (list, tuple))
+                else cfg.get(k)
+                for k in _CPSAM_KEYS
+            ),
         )
         if arch_key not in self._fwd_cache:
             self._fwd_cache[arch_key] = jax.jit(
@@ -629,6 +765,7 @@ class CellposeFinetune:
         fwd = self._fwd_cache[arch_key]
         if params is None:
             params = self._load_snapshot(session)
+        x = _to_model_channels(x, cfg)
         H, W = x.shape[1:3]
         bh, bw = bucket_shape((H, W), divisor=divisor)
         pred = np.asarray(fwd(params, pad_to(x, (bh, bw))))

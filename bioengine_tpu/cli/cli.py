@@ -14,6 +14,7 @@ import click
 from bioengine_tpu.cli.apps import apps_group
 from bioengine_tpu.cli.call import call_command
 from bioengine_tpu.cli.cluster import cluster_group
+from bioengine_tpu.cli.models import models_group
 
 
 @click.group()
@@ -25,6 +26,7 @@ def main() -> None:
 main.add_command(call_command)
 main.add_command(apps_group)
 main.add_command(cluster_group)
+main.add_command(models_group)
 
 
 @main.command("status")

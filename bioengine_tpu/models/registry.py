@@ -64,6 +64,16 @@ def _cellpose_sam(**kw) -> nn.Module:
     return CellposeSAM(**kw)
 
 
+@register_model("cpsam")
+def _cpsam(**kw) -> nn.Module:
+    from bioengine_tpu.models.sam import CpSAM
+
+    # global_attn_indexes arrives as a list from YAML/JSON kwargs
+    if "global_attn_indexes" in kw:
+        kw["global_attn_indexes"] = tuple(kw["global_attn_indexes"])
+    return CpSAM(**kw)
+
+
 @register_model("stardist2d")
 def _stardist2d(**kw) -> nn.Module:
     from bioengine_tpu.models.stardist import StarDist2D

@@ -116,6 +116,165 @@ def dinov2_name_map(depth: int = 12) -> dict[str, Rule]:
     return m
 
 
+def cpsam_name_map(depth: int = 24) -> dict[str, Rule]:
+    """Name map: cpsam torch checkpoint -> bioengine_tpu.models.sam.CpSAM.
+
+    cpsam (``cellpose.vit_sam.Transformer``, the default
+    ``pretrained_model`` of the reference's finetuning app — ref
+    apps/cellpose-finetuning/main.py:2248, model_template.py) is the
+    segment-anything ImageEncoderViT under an ``encoder.`` prefix plus
+    a transposed-conv 3-channel readout ``out``. The SAM encoder key
+    layout (patch_embed.proj, pos_embed, blocks.N.{norm1,attn.qkv,
+    attn.rel_pos_h/w,attn.proj,norm2,mlp.lin1/lin2}, neck.0..3) is the
+    public segment-anything checkpoint format. Unmapped keys raise
+    under ``strict`` and name themselves — if a cellpose release shifts
+    a key, the error says exactly which.
+    """
+    ident = lambda w: w  # noqa: E731
+    m: dict[str, Rule] = {
+        "encoder.patch_embed.proj.weight": (
+            "encoder/patch_embed/kernel", conv_kernel,
+        ),
+        "encoder.patch_embed.proj.bias": ("encoder/patch_embed/bias", ident),
+        # SAM stores pos_embed already as (1, gh, gw, dim) — NHWC
+        "encoder.pos_embed": ("encoder/pos_embed", ident),
+        "encoder.neck.0.weight": ("encoder/neck_conv1/kernel", conv_kernel),
+        "encoder.neck.1.weight": ("encoder/neck_norm1/scale", ident),
+        "encoder.neck.1.bias": ("encoder/neck_norm1/bias", ident),
+        "encoder.neck.2.weight": ("encoder/neck_conv2/kernel", conv_kernel),
+        "encoder.neck.3.weight": ("encoder/neck_norm2/scale", ident),
+        "encoder.neck.3.bias": ("encoder/neck_norm2/bias", ident),
+        "out.weight": ("out/kernel", conv_transpose_kernel),
+        "out.bias": ("out/bias", ident),
+    }
+    for i in range(depth):
+        t = f"encoder.blocks.{i}"
+        f = f"encoder/block{i}"
+        m.update(
+            {
+                f"{t}.norm1.weight": (f"{f}/norm1/scale", ident),
+                f"{t}.norm1.bias": (f"{f}/norm1/bias", ident),
+                f"{t}.attn.qkv.weight": (f"{f}/attn/qkv/kernel", linear_kernel),
+                f"{t}.attn.qkv.bias": (f"{f}/attn/qkv/bias", ident),
+                f"{t}.attn.proj.weight": (
+                    f"{f}/attn/proj/kernel", linear_kernel,
+                ),
+                f"{t}.attn.proj.bias": (f"{f}/attn/proj/bias", ident),
+                f"{t}.attn.rel_pos_h": (f"{f}/attn/rel_pos_h", ident),
+                f"{t}.attn.rel_pos_w": (f"{f}/attn/rel_pos_w", ident),
+                f"{t}.norm2.weight": (f"{f}/norm2/scale", ident),
+                f"{t}.norm2.bias": (f"{f}/norm2/bias", ident),
+                f"{t}.mlp.lin1.weight": (f"{f}/mlp_lin1/kernel", linear_kernel),
+                f"{t}.mlp.lin1.bias": (f"{f}/mlp_lin1/bias", ident),
+                f"{t}.mlp.lin2.weight": (f"{f}/mlp_lin2/kernel", linear_kernel),
+                f"{t}.mlp.lin2.bias": (f"{f}/mlp_lin2/bias", ident),
+            }
+        )
+    return m
+
+
+def synthetic_cpsam_state_dict(
+    patch_size: int = 8,
+    dim: int = 32,
+    depth: int = 2,
+    num_heads: int = 2,
+    window_size: int = 2,
+    global_attn_indexes=(1,),
+    neck_dim: int = 16,
+    pretrain_grid: int = 4,
+    mlp_ratio: float = 4.0,
+    seed: int = 0,
+) -> dict[str, np.ndarray]:
+    """Deterministic torch-layout cpsam checkpoint at any size — the
+    executable documentation of the layout ``cpsam_name_map`` expects
+    (SAM ImageEncoderViT under ``encoder.`` + ``out`` readout). Used by
+    the conversion tests and by CI to validate the CLI path without a
+    real multi-GB download; defaults are a tiny config (the real ViT-L
+    shape is patch 8 / dim 1024 / depth 24 / heads 16 / window 14 /
+    global (5, 11, 17, 23) / grid 32)."""
+    rng = np.random.default_rng(seed)
+    f32 = lambda *s: rng.standard_normal(s).astype(np.float32)  # noqa: E731
+    hd, mlp = dim // num_heads, int(dim * mlp_ratio)
+    sd = {
+        "encoder.patch_embed.proj.weight": f32(dim, 3, patch_size, patch_size),
+        "encoder.patch_embed.proj.bias": f32(dim),
+        "encoder.pos_embed": f32(1, pretrain_grid, pretrain_grid, dim),
+        "encoder.neck.0.weight": f32(neck_dim, dim, 1, 1),
+        "encoder.neck.1.weight": f32(neck_dim),
+        "encoder.neck.1.bias": f32(neck_dim),
+        "encoder.neck.2.weight": f32(neck_dim, neck_dim, 3, 3),
+        "encoder.neck.3.weight": f32(neck_dim),
+        "encoder.neck.3.bias": f32(neck_dim),
+        "out.weight": f32(neck_dim, 3, patch_size, patch_size),
+        "out.bias": f32(3),
+    }
+    for i in range(depth):
+        s = window_size if i not in global_attn_indexes else pretrain_grid
+        sd.update(
+            {
+                f"encoder.blocks.{i}.norm1.weight": f32(dim),
+                f"encoder.blocks.{i}.norm1.bias": f32(dim),
+                f"encoder.blocks.{i}.attn.qkv.weight": f32(3 * dim, dim),
+                f"encoder.blocks.{i}.attn.qkv.bias": f32(3 * dim),
+                f"encoder.blocks.{i}.attn.proj.weight": f32(dim, dim),
+                f"encoder.blocks.{i}.attn.proj.bias": f32(dim),
+                f"encoder.blocks.{i}.attn.rel_pos_h": f32(2 * s - 1, hd),
+                f"encoder.blocks.{i}.attn.rel_pos_w": f32(2 * s - 1, hd),
+                f"encoder.blocks.{i}.norm2.weight": f32(dim),
+                f"encoder.blocks.{i}.norm2.bias": f32(dim),
+                f"encoder.blocks.{i}.mlp.lin1.weight": f32(mlp, dim),
+                f"encoder.blocks.{i}.mlp.lin1.bias": f32(mlp),
+                f"encoder.blocks.{i}.mlp.lin2.weight": f32(dim, mlp),
+                f"encoder.blocks.{i}.mlp.lin2.bias": f32(dim),
+            }
+        )
+    return sd
+
+
+ARCH_NAME_MAPS: dict[str, Callable[[int], dict[str, Rule]]] = {
+    "cpsam": cpsam_name_map,
+    "dinov2": dinov2_name_map,
+}
+
+
+def infer_depth(state_dict: Mapping[str, np.ndarray]) -> int:
+    """Transformer depth from the highest ``blocks.N.`` index."""
+    import re
+
+    idx = [
+        int(m.group(1))
+        for k in state_dict
+        for m in [re.search(r"blocks\.(\d+)\.", k)]
+        if m
+    ]
+    if not idx:
+        raise ValueError("no 'blocks.N.' keys — not a ViT state dict?")
+    return max(idx) + 1
+
+
+def convert_checkpoint(
+    arch: str,
+    checkpoint_path: str,
+    out_path: str,
+    depth: int | None = None,
+    strict: bool = True,
+) -> dict[str, Any]:
+    """Fetch-and-convert entry point: torch checkpoint file ->
+    flat-npz ``jax_params`` (the weight format every app consumes:
+    embedder ``weights_path``, model-runner ``jax_params``, finetuning
+    ``pretrained_path``). Returns the converted pytree."""
+    if arch not in ARCH_NAME_MAPS:
+        raise ValueError(
+            f"unknown arch '{arch}' — have {sorted(ARCH_NAME_MAPS)}"
+        )
+    sd = load_torch_state_dict(checkpoint_path)
+    if depth is None:
+        depth = infer_depth(sd)
+    params = convert_state_dict(sd, ARCH_NAME_MAPS[arch](depth), strict=strict)
+    save_params_npz(out_path, params)
+    return params
+
+
 def count_params(params: Any) -> int:
     import jax
 

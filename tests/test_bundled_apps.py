@@ -959,6 +959,132 @@ class TestCellposeSamBackbone:
         assert pred.shape == (1, 32, 32, 3)
 
 
+CPSAM_TINY = {
+    "patch_size": 8,
+    "dim": 32,
+    "depth": 2,
+    "num_heads": 2,
+    "window_size": 2,
+    "global_attn_indexes": [1],
+    "neck_dim": 16,
+    "pretrain_grid": 4,
+}
+
+
+class TestCellposeCpsamPretrained:
+    """Fine-tuning starts from CONVERTED pretrained weights — the
+    reference app's entire value proposition (it fine-tunes the cpsam
+    foundation model, ref apps/cellpose-finetuning/main.py:2248). A
+    synthetic checkpoint in the cpsam torch layout is converted to
+    jax_params and a session launched with ``pretrained_path`` must
+    train FROM those weights, not random init."""
+
+    def _converted(self, tmp_path):
+        from bioengine_tpu.runtime.convert import (
+            convert_state_dict,
+            cpsam_name_map,
+            save_params_npz,
+            synthetic_cpsam_state_dict,
+        )
+
+        sd = synthetic_cpsam_state_dict(
+            **{k: (tuple(v) if isinstance(v, list) else v)
+               for k, v in CPSAM_TINY.items()}
+        )
+        params = convert_state_dict(sd, cpsam_name_map(depth=2), strict=True)
+        path = tmp_path / "cpsam_tiny.npz"
+        save_params_npz(str(path), params)
+        return path, params
+
+    async def test_session_starts_from_converted_weights(
+        self, cellpose_app, tmp_path
+    ):
+        result, server = cellpose_app
+        sid = result["service_id"]
+        images, masks = _synthetic_cells()
+        path, converted = self._converted(tmp_path)
+
+        # lr=0 freezes training: the session's snapshot must equal the
+        # converted checkpoint EXACTLY — proof it started from it
+        cfg = {
+            **CPSAM_TINY,
+            "backbone": "cpsam",
+            "pretrained_path": str(path),
+            "learning_rate": 0.0,
+            "weight_decay": 0.0,
+            "epochs": 1,
+            "batch_size": 2,
+            "tile": 16,
+        }
+        started = await call(
+            server, sid, "start_training",
+            train_images=images, train_labels=masks, config=cfg,
+            session_id="cpsam-pre",
+        )
+        assert started["status"] == "started"
+        final = await wait_for_status(
+            server, sid, "cpsam-pre", {"completed", "failed"}
+        )
+        assert final["status"] == "completed", final.get("error")
+
+        from bioengine_tpu.runtime.convert import (
+            flatten_params,
+            load_params_npz,
+        )
+
+        exported = await call(
+            server, sid, "export_model", session_id="cpsam-pre",
+            model_name="cpsam-pre-export",
+        )
+        got = flatten_params(
+            load_params_npz(str(Path(exported["model_path"]) / "weights.npz"))
+        )
+        want = flatten_params(converted)
+        assert set(got) == set(want)
+        np.testing.assert_allclose(
+            got["encoder/block0/attn/qkv/kernel"],
+            want["encoder/block0/attn/qkv/kernel"],
+            rtol=0, atol=0,
+        )
+        np.testing.assert_allclose(
+            got["out/kernel"], want["out/kernel"], rtol=0, atol=0
+        )
+
+        # live inference works off the pretrained-initialized snapshot
+        out = await call(
+            server, sid, "infer", session_id="cpsam-pre", images=images[:1]
+        )
+        assert out["masks"][0].shape == (64, 64)
+
+    async def test_wrong_architecture_checkpoint_fails_loudly(
+        self, cellpose_app, tmp_path
+    ):
+        result, server = cellpose_app
+        sid = result["service_id"]
+        images, masks = _synthetic_cells()
+        path, _ = self._converted(tmp_path)
+
+        cfg = {
+            **CPSAM_TINY,
+            "dim": 64,  # architecture no longer matches the checkpoint
+            "backbone": "cpsam",
+            "pretrained_path": str(path),
+            "epochs": 1,
+            "batch_size": 2,
+            "tile": 16,
+        }
+        await call(
+            server, sid, "start_training",
+            train_images=images, train_labels=masks, config=cfg,
+            session_id="cpsam-bad",
+        )
+        final = await wait_for_status(
+            server, sid, "cpsam-bad", {"completed", "failed"}
+        )
+        assert final["status"] == "failed"
+        assert "does not match the configured architecture" in final["error"]
+
+
 class TestAppFrontends:
     """Every bundled app with a reference-frontend analog ships one,
     staged by the builder and served at /apps/{app_id}/ (parity: the

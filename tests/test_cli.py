@@ -331,3 +331,39 @@ def test_read_dir_files_skips_hidden_dirs(tmp_path):
     (tmp_path / ".env").write_text("TOKEN=x")
     files = read_dir_files(tmp_path)
     assert set(files) == {"manifest.yaml"}
+
+
+def test_cli_models_list_and_convert(tmp_path):
+    """`bioengine models convert --arch cpsam`: torch checkpoint file ->
+    flat-npz jax_params consumable by the finetuning app / model-runner
+    (covers load_torch_state_dict + name map + npz write end-to-end)."""
+    import torch
+
+    from bioengine_tpu.runtime.convert import (
+        load_params_npz,
+        synthetic_cpsam_state_dict,
+    )
+
+    runner = CliRunner()
+    result = runner.invoke(cli_main, ["models", "list"])
+    assert result.exit_code == 0, result.stdout
+    assert "cpsam" in json.loads(result.stdout)
+
+    sd = synthetic_cpsam_state_dict()
+    ckpt = tmp_path / "cpsam.pth"
+    torch.save({k: torch.from_numpy(v) for k, v in sd.items()}, ckpt)
+    out = tmp_path / "cpsam.npz"
+    result = runner.invoke(
+        cli_main,
+        ["models", "convert", str(ckpt), str(out), "--arch", "cpsam"],
+    )
+    assert result.exit_code == 0, result.output
+    info = json.loads(result.stdout.strip().splitlines()[-1])
+    assert info["n_params"] > 0 and set(info["top_level"]) == {
+        "encoder", "out",
+    }
+    params = load_params_npz(str(out))
+    np.testing.assert_array_equal(
+        params["encoder"]["block0"]["attn"]["qkv"]["kernel"],
+        sd["encoder.blocks.0.attn.qkv.weight"].T,
+    )
