@@ -15,6 +15,10 @@ auto-selection policy but TPU-first execution:
   reference's layout), ADC lookup-table search; encode runs on device
   (per-subspace distance matmuls), query scan is numpy over the probed
   lists' codes.
+- **PQFlatTPU** (>= 5M when a TPU is present): the same PQ codes held
+  RESIDENT in HBM and exact-scanned per query by a jitted gather
+  scan + on-device top-k — no probe selection, no recall loss; 58M
+  codes are ~5.5 GB and fit one v5e chip.
 
 Persistence: ``cell_search_index.npz`` + ``metadata.parquet`` +
 ``index_info.json`` under ``<workspace>/index`` — same file roles as
@@ -200,6 +204,35 @@ class IVFFlatIndex:
         )
 
 
+def _train_pq(
+    vectors: np.ndarray,
+    M: int,
+    ksub_max: int,
+    train_n: Optional[int] = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per-subspace PQ training + full encode, shared by IVFPQIndex
+    (on residuals) and PQFlatIndex (on raw vectors). Returns
+    (codebooks (M, ksub, dsub), codes (N, M) uint8)."""
+    from sklearn.cluster import MiniBatchKMeans
+
+    n, d = vectors.shape
+    assert d % M == 0, f"dim {d} not divisible by m={M}"
+    dsub = d // M
+    train_len = train_n or min(n, 1_000_000)
+    ksub = min(ksub_max, train_len)
+    codebooks = np.empty((M, ksub, dsub), np.float32)
+    codes = np.empty((n, M), np.uint8)
+    for m in range(M):
+        sub = np.ascontiguousarray(vectors[:, m * dsub : (m + 1) * dsub])
+        km = MiniBatchKMeans(
+            n_clusters=ksub, batch_size=8192, n_init=1, random_state=m
+        )
+        km.fit(sub[:train_len])
+        codebooks[m] = km.cluster_centers_
+        codes[:, m] = km.predict(sub).astype(np.uint8)
+    return codebooks, codes
+
+
 class IVFPQIndex:
     """IVF + product quantization: 96 bytes/vector (m=96 subspaces x
     8 bits), asymmetric-distance search over probed lists."""
@@ -236,9 +269,8 @@ class IVFPQIndex:
         from sklearn.cluster import MiniBatchKMeans
 
         n, d = embeddings.shape
-        assert d % cls.M == 0, f"dim {d} not divisible by m={cls.M}"
-        dsub = d // cls.M
-        train = embeddings[: (train_n or min(n, 1_000_000))]
+        train_len = train_n or min(n, 1_000_000)
+        train = embeddings[:train_len]
 
         coarse = MiniBatchKMeans(
             n_clusters=nlist, batch_size=8192, n_init=3, random_state=0
@@ -246,19 +278,7 @@ class IVFPQIndex:
         coarse.fit(train)
         assignments = coarse.predict(embeddings)
         residuals = embeddings - coarse.cluster_centers_[assignments]
-
-        ksub = min(cls.KSUB, len(train))
-        codebooks = np.empty((cls.M, ksub, dsub), np.float32)
-        codes = np.empty((n, cls.M), np.uint8)
-        for m in range(cls.M):
-            sub = residuals[:, m * dsub : (m + 1) * dsub]
-            km = MiniBatchKMeans(
-                n_clusters=ksub, batch_size=8192, n_init=1,
-                random_state=m,
-            )
-            km.fit(sub[: len(train)])
-            codebooks[m] = km.cluster_centers_
-            codes[:, m] = km.predict(sub).astype(np.uint8)
+        codebooks, codes = _train_pq(residuals, cls.M, cls.KSUB, train_len)
 
         order = np.argsort(assignments, kind="stable")
         sorted_assign = assignments[order]
@@ -283,6 +303,11 @@ class IVFPQIndex:
         nprobe = min(self.nprobe, len(self.centroids))
         cscores = q @ self.centroids.T
         probes = np.argpartition(-cscores, nprobe - 1, axis=1)[:, :nprobe]
+        # flat-LUT layout: one 1-D gather of (codes + per-subspace
+        # offset) replaces a 2-array fancy index — and concatenating
+        # every probed list's (contiguous, list-sorted) code block
+        # first turns 32 small per-list gathers into ONE big one
+        offs = (np.arange(self.M, dtype=np.int32) * self.codebooks.shape[1])
         all_s, all_i = [], []
         for row, plist in enumerate(probes):
             qr = q[row]
@@ -296,18 +321,24 @@ class IVFPQIndex:
                 "mkd,md->mk",
                 self.codebooks,
                 qr.reshape(self.M, self.dsub),
-            )  # (M, KSUB)
-            parts_s, parts_i = [], []
-            for p in plist:
-                s0, s1 = self.list_bounds[p]
-                if s1 <= s0:
-                    continue
-                codes = self.codes[s0:s1]  # (L, M)
-                scores = lut[np.arange(self.M)[None, :], codes].sum(axis=1)
-                scores = scores + float(qr @ self.centroids[p])
-                parts_s.append(scores)
-                parts_i.append(self.ids[s0:s1])
-            s, i = _topk_pad(parts_s, parts_i, top_k)
+            ).ravel()  # (M * KSUB,)
+            bounds = self.list_bounds[plist]
+            live = bounds[:, 1] > bounds[:, 0]
+            if not live.any():
+                s, i = _topk_pad([], [], top_k)
+                all_s.append(s)
+                all_i.append(i)
+                continue
+            bounds = bounds[live]
+            lens = bounds[:, 1] - bounds[:, 0]
+            codes = np.concatenate(
+                [self.codes[s0:s1] for s0, s1 in bounds]
+            )  # (Ltot, M)
+            ids = np.concatenate([self.ids[s0:s1] for s0, s1 in bounds])
+            scores = lut[codes.astype(np.int32) + offs].sum(axis=1)
+            # q·c base term: reuse the coarse scores already computed
+            scores += np.repeat(cscores[row, plist[live]], lens)
+            s, i = _topk_pad([scores], [ids], top_k)
             all_s.append(s)
             all_i.append(i)
         return np.stack(all_s), np.stack(all_i)
@@ -352,7 +383,143 @@ class IVFPQIndex:
         )
 
 
-_KINDS = {c.kind: c for c in (FlatIPIndex, IVFFlatIndex, IVFPQIndex)}
+class PQFlatIndex:
+    """Device-resident PQ flat scan — the TPU-native answer to FAISS's
+    CPU IVFPQ at full-corpus scale.
+
+    Codes live in TPU HBM as an (M, N) uint8 plane: at 96 bytes/vector
+    the reference's ENTIRE 58M-cell JUMP corpus is ~5.5 GB — it fits a
+    single v5e chip's HBM, so search needs no coarse quantizer, no
+    probe selection, and no recall loss from unprobed lists: every
+    query exactly-scans all N codes. Per query the ADC table (M x 256
+    inner products) uploads ~100 KB; the scan is a jitted
+    ``lax.scan`` over subspaces accumulating ``take`` gathers — pure
+    HBM-bandwidth work the VPU streams — followed by an on-device
+    ``top_k`` so only (Q, k) scores/ids ever cross the wire. The
+    reference's CPU path scans <0.2% of the corpus (nprobe/nlist) to
+    hit <80 ms at 58M; this scans 100% of it from HBM instead of RAM.
+    """
+
+    kind = "PQFlatTPU"
+    M = 96
+    KSUB = 256
+
+    def __init__(
+        self,
+        codebooks: np.ndarray,     # (M, KSUB, dsub)
+        codes: np.ndarray,         # (N, M) uint8
+        ids: Optional[np.ndarray] = None,
+    ):
+        self.codebooks = codebooks.astype(np.float32)
+        self.codes = codes
+        self.ids = (
+            ids.astype(np.int64)
+            if ids is not None
+            else np.arange(len(codes), dtype=np.int64)
+        )
+        self.dsub = codebooks.shape[-1]
+        self._codes_dev = None
+        self._topk_fns: dict[int, Any] = {}
+
+    @classmethod
+    def build(
+        cls,
+        embeddings: np.ndarray,
+        train_n: Optional[int] = None,
+    ) -> "PQFlatIndex":
+        codebooks, codes = _train_pq(
+            embeddings, cls.M, cls.KSUB, train_n
+        )
+        if codebooks.shape[1] < cls.KSUB:  # tiny corpora: pad to 8-bit
+            codebooks = np.pad(
+                codebooks,
+                ((0, 0), (0, cls.KSUB - codebooks.shape[1]), (0, 0)),
+            )
+        return cls(codebooks, codes)
+
+    @property
+    def ntotal(self) -> int:
+        return len(self.codes)
+
+    def _scan_fn(self, k: int):
+        """Jitted full-corpus ADC scan + top-k, cached per k (top_k is
+        a compile-time constant for lax.top_k)."""
+        if k in self._topk_fns:
+            return self._topk_fns[k]
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def run(luts, codes_t):
+            # luts: (Q, M, KSUB); codes_t: (M, N) uint8 — RESIDENT at
+            # 1 byte/code (the whole point: 58M x 96 = ~5.5 GB fits one
+            # chip); each scan step widens ONE (N,) row to int32 for
+            # the gather, a transient XLA handles, never 4x residency
+            def body(acc, mk):
+                lut_m, codes_m = mk        # (Q, KSUB), (N,) uint8
+                idx = codes_m.astype(jnp.int32)
+                return acc + jnp.take(lut_m, idx, axis=1), None
+
+            acc0 = jnp.zeros(
+                (luts.shape[0], codes_t.shape[1]), jnp.float32
+            )
+            scores, _ = jax.lax.scan(
+                body, acc0, (jnp.moveaxis(luts, 1, 0), codes_t)
+            )
+            return jax.lax.top_k(scores, k)
+
+        self._topk_fns[k] = run
+        return run
+
+    def search(self, query: np.ndarray, top_k: int):
+        import jax.numpy as jnp
+
+        if self._codes_dev is None:
+            self._codes_dev = jnp.asarray(
+                np.ascontiguousarray(self.codes.T)  # stays uint8 in HBM
+            )
+        q = np.atleast_2d(query).astype(np.float32)
+        luts = np.einsum(
+            "mkd,qmd->qmk",
+            self.codebooks,
+            q.reshape(len(q), self.M, self.dsub),
+        )
+        k = min(top_k, self.ntotal)
+        s, i = self._scan_fn(k)(jnp.asarray(luts), self._codes_dev)
+        s, i = np.asarray(s), np.asarray(i)
+        out_s = np.full((len(q), top_k), -np.inf, np.float32)
+        out_i = np.full((len(q), top_k), -1, np.int64)
+        out_s[:, :k] = s
+        out_i[:, :k] = self.ids[i]
+        return out_s, out_i
+
+    def reconstruct(self, ids: np.ndarray) -> np.ndarray:
+        pos = np.empty(int(self.ids.max()) + 1, np.int64)
+        pos[self.ids] = np.arange(len(self.ids))
+        code = self.codes[pos[np.asarray(ids)]]          # (B, M)
+        resid = self.codebooks[
+            np.arange(self.M)[None, :], code
+        ]                                                 # (B, M, dsub)
+        return resid.reshape(len(code), -1).astype(np.float32)
+
+    def save(self, path: Path):
+        np.savez_compressed(
+            path,
+            kind=self.kind,
+            codebooks=self.codebooks,
+            codes=self.codes,
+            ids=self.ids,
+        )
+
+    @classmethod
+    def load(cls, data) -> "PQFlatIndex":
+        return cls(data["codebooks"], data["codes"], data["ids"])
+
+
+_KINDS = {
+    c.kind: c
+    for c in (FlatIPIndex, IVFFlatIndex, IVFPQIndex, PQFlatIndex)
+}
 
 
 # ---------------------------------------------------------------------------
@@ -380,8 +547,15 @@ def build_index(
         nlist = min(4096, max(64, int(np.sqrt(n_target))), n)
         index = IVFFlatIndex.build(embeddings, nlist)
     else:
-        nlist = min(65536, max(4096, int(np.sqrt(n_target))), n)
-        index = IVFPQIndex.build(embeddings, nlist)
+        import jax
+
+        if jax.default_backend() == "tpu":
+            # HBM-resident exact PQ scan: zero probe-miss recall loss,
+            # and the whole 58M-scale corpus fits one chip
+            index = PQFlatIndex.build(embeddings)
+        else:
+            nlist = min(65536, max(4096, int(np.sqrt(n_target))), n)
+            index = IVFPQIndex.build(embeddings, nlist)
 
     index_path = out / "cell_search_index.npz"
     index.save(index_path)

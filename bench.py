@@ -56,7 +56,8 @@ Env overrides:
   BENCH_TIMEOUT=N       per-attempt cap, also capped by the deadline
   BENCH_STALL=N         kill an attempt after N s with no stage output
                         (mid-stage wedge detector; default 240)
-  BENCH_CONFIGS=a,b,c   subset of vit,unet,cellpose,search,flash,unet3d,ivfpq
+  BENCH_CONFIGS=a,b,c   subset of vit,unet,cellpose,search,flash,
+                        unet3d,ivfpq,pqflat
   BENCH_REPS=N          timed reps per stage (default 2, best-of)
   BENCH_PROFILE=dir     capture a jax.profiler trace of one rep per config
 """
@@ -84,6 +85,7 @@ STAGE_COSTS = {
     "flash": 55,
     "unet3d": 70,
     "ivfpq": 130,
+    "pqflat": 80,
 }
 DEFAULT_CONFIGS = tuple(STAGE_COSTS)
 
@@ -528,6 +530,35 @@ def _bench_ivfpq(cpu: bool) -> dict:
     }
 
 
+def _bench_pqflat(cpu: bool) -> dict:
+    """Device-resident PQ exact scan (PQFlatTPU) at 1M codes: the
+    HBM-resident alternative to CPU IVFPQ — no probe selection, no
+    recall loss, the full 58M-scale corpus fits one chip
+    (apps/cell-image-search/index.py PQFlatIndex). Codes here are
+    random uint8 (the gather+accumulate+top_k cost is independent of
+    code values); the per-query ADC tables are real."""
+    import numpy as np
+
+    mod = _load_index_module()
+    rng = np.random.default_rng(0)
+    n = 50_000 if cpu else 1_000_000
+    dim = 768
+    codebooks = rng.standard_normal((96, 256, 8)).astype(np.float32)
+    codes = rng.integers(0, 256, (n, 96), dtype=np.uint8)
+    index = mod.PQFlatIndex(codebooks, codes)
+    sample = rng.standard_normal((64, dim)).astype(np.float32)
+    sample /= np.linalg.norm(sample, axis=1, keepdims=True)
+    timing = _time_index(index, sample, rng, dim, n_single=10, n_batch=3)
+    return {
+        **timing,
+        # codes stay uint8 on device (1 byte/code), so host nbytes IS
+        # the HBM residency
+        "resident_bytes": int(index._codes_dev.nbytes),
+        "corpus_note": f"{n} random codes, exact full scan on device "
+        "(no IVF probes); 58M would be ~5.5 GB HBM-resident",
+    }
+
+
 def worker_main() -> int:
     cpu = os.environ.get("BENCH_PLATFORM", "").lower() == "cpu"
     if cpu:
@@ -578,6 +609,7 @@ def worker_main() -> int:
         "search": _bench_search,
         "flash": _bench_flash,
         "ivfpq": _bench_ivfpq,
+        "pqflat": _bench_pqflat,
     }
     wanted = [
         n.strip()
@@ -810,6 +842,7 @@ def _final_json(shared: _Shared, deadline_hit: bool) -> str:
             "unet3d": shared.stages.get("unet3d"),
             "search_latency": shared.stages.get("search"),
             "ivfpq_1m": shared.stages.get("ivfpq"),
+            "pqflat_tpu_1m": shared.stages.get("pqflat"),
             "flash_attention": shared.stages.get("flash"),
             "cellpose_finetune": shared.stages.get("cellpose"),
             "attempts": shared.attempts,

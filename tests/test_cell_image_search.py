@@ -127,12 +127,36 @@ class TestIndexVariants:
             hits += int(q in ids[0])
         assert hits >= 24  # PQ is lossy; self-recall@10 stays high
 
+    def test_pqflat_exact_scan_recall_and_batch(self, tmp_path):
+        """Device-resident PQ flat scan: exact over ALL codes, so
+        self-recall@10 must be at least as good as probed IVFPQ; batch
+        queries return per-row results through the jitted scan."""
+        emb = _random_unit(600, seed=5)
+        idx = index_mod.PQFlatIndex.build(emb)
+        assert idx.ntotal == 600
+        hits = 0
+        for q in range(30):
+            _, ids = idx.search(emb[q], 10)
+            hits += int(q in ids[0])
+        assert hits >= 26, hits  # no probe misses — PQ loss only
+        s, i = idx.search(emb[:8], 5)
+        assert s.shape == (8, 5) and i.shape == (8, 5)
+        # batch rows match single-query results (same jitted scan)
+        s1, i1 = idx.search(emb[3], 5)
+        np.testing.assert_array_equal(i[3], i1[0])
+        rec = idx.reconstruct(np.array([0, 7]))
+        assert rec.shape == (2, emb.shape[1])
+        # quantized reconstruction stays close in angle
+        cos = (rec[0] / np.linalg.norm(rec[0])) @ emb[0]
+        assert cos > 0.8, cos
+
     def test_save_load_roundtrip(self, tmp_path):
         emb = _random_unit(1200, seed=3)
         for built in (
             index_mod.FlatIPIndex(emb),
             index_mod.IVFFlatIndex.build(emb, nlist=8),
             index_mod.IVFPQIndex.build(emb, nlist=4),
+            index_mod.PQFlatIndex.build(emb),
         ):
             p = tmp_path / f"{built.kind}.npz"
             built.save(p)
