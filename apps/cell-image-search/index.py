@@ -218,7 +218,7 @@ def _train_pq(
     n, d = vectors.shape
     assert d % M == 0, f"dim {d} not divisible by m={M}"
     dsub = d // M
-    train_len = train_n or min(n, 1_000_000)
+    train_len = min(train_n or min(n, 1_000_000), n)
     ksub = min(ksub_max, train_len)
     codebooks = np.empty((M, ksub, dsub), np.float32)
     codes = np.empty((n, M), np.uint8)
@@ -471,6 +471,13 @@ class PQFlatIndex:
         self._topk_fns[k] = run
         return run
 
+    # cap on the transient (Q_chunk, N) f32 score plane the scan holds
+    # in HBM: at 58M codes a 64-query batch would be ~15 GB and OOM the
+    # chip whose 5.5 GB code residency is the whole selling point, so
+    # batches chunk to keep scores under this budget (58M -> 8/chunk;
+    # 1M -> the full batch)
+    SCORE_BUDGET_BYTES = 2 << 30
+
     def search(self, query: np.ndarray, top_k: int):
         import jax.numpy as jnp
 
@@ -479,18 +486,20 @@ class PQFlatIndex:
                 np.ascontiguousarray(self.codes.T)  # stays uint8 in HBM
             )
         q = np.atleast_2d(query).astype(np.float32)
-        luts = np.einsum(
-            "mkd,qmd->qmk",
-            self.codebooks,
-            q.reshape(len(q), self.M, self.dsub),
-        )
         k = min(top_k, self.ntotal)
-        s, i = self._scan_fn(k)(jnp.asarray(luts), self._codes_dev)
-        s, i = np.asarray(s), np.asarray(i)
+        q_chunk = max(1, int(self.SCORE_BUDGET_BYTES // (self.ntotal * 4)))
         out_s = np.full((len(q), top_k), -np.inf, np.float32)
         out_i = np.full((len(q), top_k), -1, np.int64)
-        out_s[:, :k] = s
-        out_i[:, :k] = self.ids[i]
+        for c0 in range(0, len(q), q_chunk):
+            qc = q[c0 : c0 + q_chunk]
+            luts = np.einsum(
+                "mkd,qmd->qmk",
+                self.codebooks,
+                qc.reshape(len(qc), self.M, self.dsub),
+            )
+            s, i = self._scan_fn(k)(jnp.asarray(luts), self._codes_dev)
+            out_s[c0 : c0 + len(qc), :k] = np.asarray(s)
+            out_i[c0 : c0 + len(qc), :k] = self.ids[np.asarray(i)]
         return out_s, out_i
 
     def reconstruct(self, ids: np.ndarray) -> np.ndarray:

@@ -184,8 +184,12 @@ def extract_cell_crops(
         stride = max(
             crop_size, min(H, W) // max(1, int(np.sqrt(n_crops)))
         )
+        # range() already starts at the first valid CENTER (half) —
+        # adding half again offset the whole grid by a half-window,
+        # pushing every crop past the image edge whenever crop_size
+        # was close to the image size (0 crops out of a valid image)
         centroids = [
-            (y + half, x + half)
+            (y, x)
             for y in range(half, H - half + 1, stride)
             for x in range(half, W - half + 1, stride)
         ][:n_crops]

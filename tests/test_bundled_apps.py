@@ -1279,6 +1279,14 @@ class TestTutorialNotebook:
             ["cells found:"],
         )
 
+    async def test_search_notebook_executes(self, tmp_path):
+        await self._run_notebook(
+            REPO_APPS / "cell-image-search"
+            / "tutorial_cell_image_search.ipynb",
+            tmp_path,
+            ["index:", "matches:", "projection points:"],
+        )
+
     async def test_demo_notebook_executes(self, tmp_path):
         await self._run_notebook(
             REPO_APPS / "demo-app" / "tutorial.ipynb",

@@ -83,6 +83,17 @@ class TestCropExtraction:
         assert len(crops) >= 5
         assert all(c.shape[:2] == (96, 96) for c in crops)
 
+    def test_grid_fallback_crop_size_near_image_size(self):
+        """Regression: the fallback grid double-offset its centers by
+        half a window, so a crop_size close to the image size yielded
+        ZERO crops from a perfectly valid image ('No cells found')."""
+        img = np.random.default_rng(0).normal(40, 5, (256, 256)).astype(
+            np.float32
+        )
+        crops = ingestion.extract_cell_crops(img, crop_size=224)
+        assert len(crops) >= 1
+        assert all(c.shape[:2] == (224, 224) for c in crops)
+
     def test_grid_fallback_on_flat_image(self):
         img = np.random.default_rng(0).normal(10, 0.1, (300, 300))
         crops = ingestion.extract_cell_crops(img, crop_size=64, n_crops=9)
