@@ -44,13 +44,20 @@ DEFAULT_CONFIG = {
     # to a converted checkpoint (runtime.convert.convert_checkpoint /
     # `bioengine models convert --arch cpsam`) to fine-tune from the
     # foundation weights like the reference does
-    # (ref apps/cellpose-finetuning/main.py:2248, model_type="cpsam")
+    # (ref apps/cellpose-finetuning/main.py:2248, model_type="cpsam");
+    # "stardist" = models/stardist.StarDist2D, star-convex polygons
+    # (prob + ray-distance heads) instead of flow fields — a capability
+    # the reference app does not have (it is cellpose-only)
     "backbone": "unet",
-    "features": [32, 64, 128, 256],      # unet backbone
+    "features": [32, 64, 128, 256],      # unet/stardist backbones
     "patch_size": 8,                      # sam/cpsam backbones
     "dim": 256,
     "depth": 8,
     "num_heads": 8,
+    "n_rays": 32,                         # stardist backbone (even)
+    "max_dist": 64,                       # stardist ray-length cap (px):
+    #   raise it when instances exceed ~64 px radius or ray targets (and
+    #   therefore predicted polygons) truncate at the cap
     "pretrained_path": None,              # flat-npz jax_params to start from
     "learning_rate": 1e-4,
     "weight_decay": 1e-5,
@@ -86,6 +93,11 @@ def _merge_config(config: Optional[dict]) -> dict:
         for k, v in _CPSAM_ARCH_DEFAULTS.items():
             if k not in config:
                 cfg[k] = v
+    if cfg.get("backbone") == "stardist" and int(cfg["n_rays"]) % 2:
+        # reject HERE, synchronously in start_training — target
+        # derivation is the expensive step and must not run for a
+        # config the train loop would refuse anyway
+        raise ValueError(f"n_rays must be even, got {cfg['n_rays']}")
     return cfg
 
 
@@ -167,6 +179,17 @@ def build_model(cfg: dict):
             in_channels=2,
         )
         return model, model.divisor
+    if backbone == "stardist":
+        from bioengine_tpu.models.stardist import StarDist2D
+
+        # always merged by _merge_config (which also rejects odd counts
+        # — the horizontal-flip augmentation permutes ray indices by
+        # (n_rays/2 - r) mod n_rays, only a bijection for even counts)
+        model = StarDist2D(
+            n_rays=int(cfg["n_rays"]), features=tuple(cfg["features"]),
+            in_channels=2,
+        )
+        return model, model.divisor
     from bioengine_tpu.models.cellpose import CellposeNet
 
     model = CellposeNet(features=tuple(cfg["features"]), in_channels=2)
@@ -198,6 +221,15 @@ def _arch_entry(cfg: dict) -> dict:
                 "dim": int(cfg.get("dim", 256)),
                 "depth": int(cfg.get("depth", 8)),
                 "num_heads": int(cfg.get("num_heads", 8)),
+                "in_channels": 2,
+            },
+        }
+    if backbone == "stardist":
+        return {
+            "name": "stardist2d",
+            "kwargs": {
+                "n_rays": int(cfg["n_rays"]),
+                "features": list(cfg["features"]),
                 "in_channels": 2,
             },
         }
@@ -369,23 +401,41 @@ class CellposeFinetune:
     def _prepare_training_data(
         self, session: TrainingSession, images: list, labels: list
     ) -> None:
-        """Normalize images, derive flow targets from masks, persist to
-        the session's data dir (restart_training reuses them)."""
-        from bioengine_tpu.ops.flows import masks_to_flows
-
+        """Normalize images, derive the backbone's targets from masks
+        (flow fields for cellpose-family backbones, edt-prob +
+        ray-distances for stardist), persist to the session's data dir
+        (restart_training reuses them)."""
         x = self._prepare_images(images)
         masks = np.stack([np.asarray(m) for m in labels]).astype(np.int32)
         if masks.shape[:3] != x.shape[:3]:
             raise ValueError(
                 f"images {x.shape[:3]} and labels {masks.shape[:3]} disagree"
             )
-        flows = np.stack([masks_to_flows(m) for m in masks])  # (N, 2, H, W)
-        flows = np.moveaxis(flows, 1, -1)  # (N, H, W, 2)
-        cellprob = (masks > 0).astype(np.float32)
-        np.savez(
-            session.data_dir / "train.npz",
-            images=x, flows=flows, cellprob=cellprob,
-        )
+        if session.config.get("backbone") == "stardist":
+            from bioengine_tpu.ops.stardist import masks_to_stardist
+
+            cfg = session.config
+            pairs = [
+                masks_to_stardist(
+                    m,
+                    n_rays=int(cfg["n_rays"]),
+                    max_dist=int(cfg["max_dist"]),
+                )
+                for m in masks
+            ]
+            targets = {
+                "prob": np.stack([p for p, _ in pairs]),       # (N, H, W)
+                "dist": np.stack([d for _, d in pairs]),       # (N, H, W, R)
+            }
+        else:
+            from bioengine_tpu.ops.flows import masks_to_flows
+
+            flows = np.stack([masks_to_flows(m) for m in masks])
+            targets = {
+                "flows": np.moveaxis(flows, 1, -1),            # (N, H, W, 2)
+                "cellprob": (masks > 0).astype(np.float32),    # (N, H, W)
+            }
+        np.savez(session.data_dir / "train.npz", images=x, **targets)
 
     # ---- the train loop (runs in a thread) -------------------------------
 
@@ -402,8 +452,13 @@ class CellposeFinetune:
         from bioengine_tpu.runtime.convert import load_params_npz
 
         cfg = session.config
+        stardist = cfg.get("backbone") == "stardist"
         data = np.load(session.data_dir / "train.npz")
-        images, flows, cellprob = data["images"], data["flows"], data["cellprob"]
+        images = data["images"]
+        if stardist:
+            t_a, t_b = data["prob"], data["dist"]          # (N,H,W), (N,H,W,R)
+        else:
+            t_a, t_b = data["flows"], data["cellprob"]     # (N,H,W,2), (N,H,W)
         n, H, W = images.shape[:3]
         model, divisor = build_model(cfg)
         # tile must divide through the encoder (pool stages / patch
@@ -465,26 +520,43 @@ class CellposeFinetune:
             if restored_state is not None
             else TrainState.create(model.apply, params, tx),
         )
-        step = jit_data_parallel_step(make_train_step(), mesh)
+        if stardist:
+            from bioengine_tpu.models.stardist import make_stardist_train_step
+
+            step = jit_data_parallel_step(make_stardist_train_step(), mesh)
+            R = t_b.shape[-1]
+            # flips permute ray indices: rays live at angles 2*pi*r/R
+            # with direction (sin, cos); x -> -x maps theta to pi-theta
+            # (index R/2 - r), y -> -y maps theta to -theta (index -r)
+            h_perm = (R // 2 - np.arange(R)) % R
+            v_perm = (-np.arange(R)) % R
+        else:
+            step = jit_data_parallel_step(make_train_step(), mesh)
 
         def sample_batch():
             idx = rng.integers(0, n, size=batch)
             ys = rng.integers(0, H - tile + 1, size=batch)
             xs = rng.integers(0, W - tile + 1, size=batch)
             bi = np.empty((batch, tile, tile, 2), np.float32)
-            bf = np.empty((batch, tile, tile, 2), np.float32)
-            bc = np.empty((batch, tile, tile), np.float32)
+            ba = np.empty((batch, tile, tile, *t_a.shape[3:]), np.float32)
+            bb = np.empty((batch, tile, tile, *t_b.shape[3:]), np.float32)
             for j, (i, y0, x0) in enumerate(zip(idx, ys, xs)):
                 sl = np.s_[y0 : y0 + tile, x0 : x0 + tile]
-                im, fl, cp = images[i][sl], flows[i][sl], cellprob[i][sl]
-                if rng.random() < 0.5:  # horizontal flip (flips x-flow sign)
-                    im, cp = im[:, ::-1], cp[:, ::-1]
-                    fl = fl[:, ::-1] * np.array([1.0, -1.0], np.float32)
-                if rng.random() < 0.5:  # vertical flip (flips y-flow sign)
-                    im, cp = im[::-1], cp[::-1]
-                    fl = fl[::-1] * np.array([-1.0, 1.0], np.float32)
-                bi[j], bf[j], bc[j] = im, fl, cp
-            return _to_model_channels(bi, cfg), bf, bc
+                im, ta, tb = images[i][sl], t_a[i][sl], t_b[i][sl]
+                if rng.random() < 0.5:  # horizontal flip
+                    im, ta, tb = im[:, ::-1], ta[:, ::-1], tb[:, ::-1]
+                    if stardist:
+                        tb = tb[..., h_perm]       # dist rays remap
+                    else:
+                        ta = ta * np.array([1.0, -1.0], np.float32)  # x-flow
+                if rng.random() < 0.5:  # vertical flip
+                    im, ta, tb = im[::-1], ta[::-1], tb[::-1]
+                    if stardist:
+                        tb = tb[..., v_perm]
+                    else:
+                        ta = ta * np.array([-1.0, 1.0], np.float32)  # y-flow
+                bi[j], ba[j], bb[j] = im, ta, tb
+            return _to_model_channels(bi, cfg), ba, bb
 
         steps_per_epoch = max(1, n * max(H // tile, 1) * max(W // tile, 1) // batch)
         session.write_status(
@@ -501,9 +573,9 @@ class CellposeFinetune:
                 if session.stop_requested():
                     session.write_status(status="stopped", current_epoch=epoch)
                     return
-                bi, bf, bc = sample_batch()
+                bi, ba, bb = sample_batch()
                 sharded = shard_batch(
-                    mesh, (jnp.asarray(bi), jnp.asarray(bf), jnp.asarray(bc))
+                    mesh, (jnp.asarray(bi), jnp.asarray(ba), jnp.asarray(bb))
                 )
                 state, metrics = step(state, *sharded)
                 epoch_losses.append(float(metrics["loss"]))
@@ -749,6 +821,7 @@ class CellposeFinetune:
             tuple(cfg["features"]),
             cfg.get("patch_size"), cfg.get("dim"),
             cfg.get("depth"), cfg.get("num_heads"),
+            cfg.get("n_rays"),
             # cpsam-only knobs change the architecture too — without
             # them two cpsam sessions differing only in e.g.
             # window_size would share one compiled model
@@ -772,9 +845,23 @@ class CellposeFinetune:
         return crop_to(pred, (H, W))
 
     def _infer(self, session, images, cellprob_threshold, min_size):
+        pred = self._predict_raw(session, self._prepare_images(images))
+        if session.config.get("backbone") == "stardist":
+            from bioengine_tpu.ops.stardist import (
+                predictions_to_masks_stardist,
+            )
+
+            # the caller-facing threshold is a LOGIT for both families
+            # (0.0 = probability 0.5); stardist's NMS takes probability
+            prob_threshold = float(1.0 / (1.0 + np.exp(-cellprob_threshold)))
+            return [
+                predictions_to_masks_stardist(
+                    p, prob_threshold=prob_threshold, min_size=min_size
+                )
+                for p in pred
+            ]
         from bioengine_tpu.ops.flows import predictions_to_masks
 
-        pred = self._predict_raw(session, self._prepare_images(images))
         return [
             predictions_to_masks(
                 p, cellprob_threshold=cellprob_threshold, min_size=min_size
@@ -803,6 +890,12 @@ class CellposeFinetune:
         upstream cellpose library; here it is first-class and the flow
         following runs jitted on TPU."""
         session = self._get_session(session_id)
+        if session.config.get("backbone") == "stardist":
+            raise RuntimeError(
+                "infer_3d needs flow-field outputs (the cellpose do_3D "
+                "recipe); the stardist backbone predicts 2D polygons — "
+                "use infer per z-slice instead"
+            )
         if not session.latest_path.exists():
             raise RuntimeError(f"session '{session_id}' has no snapshot yet")
         if anisotropy <= 0:

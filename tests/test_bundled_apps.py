@@ -959,6 +959,105 @@ class TestCellposeSamBackbone:
         assert pred.shape == (1, 32, 32, 3)
 
 
+class TestStardistBackbone:
+    """Star-convex polygons as a fine-tuning family — beyond the
+    reference app (cellpose-only): targets are edt-prob + ray
+    distances, the train step is the stardist objective, and inference
+    reconstructs instances through polygon NMS."""
+
+    # steps_per_epoch is tiny on 2 images (2 steps at tile 32), and the
+    # stardist objective needs ~100 steps before polygons clear NMS on
+    # this data (verified against a direct-train baseline), hence the
+    # higher epoch count — each epoch is milliseconds at this size
+    CFG = {
+        "backbone": "stardist",
+        "features": [8, 16],
+        "n_rays": 8,
+        "epochs": 50,
+        "batch_size": 4,
+        "tile": 32,
+        "learning_rate": 2e-3,
+    }
+
+    async def test_stardist_session_train_infer_export(self, cellpose_app):
+        result, server = cellpose_app
+        sid = result["service_id"]
+        images, masks = _synthetic_cells()
+
+        started = await call(
+            server, sid, "start_training",
+            train_images=images, train_labels=masks, config=self.CFG,
+            session_id="stardist-run",
+        )
+        assert started["status"] == "started"
+        final = await wait_for_status(
+            server, sid, "stardist-run", {"completed", "failed"}
+        )
+        assert final["status"] == "completed", final.get("error")
+        assert final["losses"][-1] < final["losses"][0]
+
+        # a few epochs on tiny data leave prob logits shy of 0 — the
+        # caller-facing logit threshold works for stardist exactly like
+        # for cellpose, so a permissive smoke threshold finds polygons
+        out = await call(
+            server, sid, "infer", session_id="stardist-run",
+            images=images[:1], cellprob_threshold=-3.0,
+        )
+        assert out["masks"][0].shape == (64, 64)
+        assert out["n_cells"][0] >= 1
+
+        # volumetric recipe needs flows — clean rejection, not a crash
+        with pytest.raises(Exception, match="do_3D|polygons"):
+            await call(
+                server, sid, "infer_3d", session_id="stardist-run",
+                volumes=[np.zeros((4, 32, 32), np.float32)],
+            )
+
+        exported = await call(
+            server, sid, "export_model", session_id="stardist-run",
+            model_name="stardist-export",
+        )
+        import yaml as _yaml
+
+        rdf = _yaml.safe_load(
+            (Path(exported["model_path"]) / "rdf.yaml").read_text()
+        )
+        arch = rdf["weights"]["jax_params"]["architecture"]
+        assert arch["name"] == "stardist2d"
+        assert arch["kwargs"]["n_rays"] == 8
+
+        # the export is servable by the model-runner registry path
+        import jax
+
+        from bioengine_tpu.models import get_model
+        from bioengine_tpu.runtime.convert import load_params_npz
+
+        model = get_model(arch["name"], **arch["kwargs"])
+        params = load_params_npz(
+            str(Path(exported["model_path"]) / "weights.npz")
+        )
+        pred = model.apply(
+            {"params": params},
+            jax.numpy.zeros((1, 32, 32, 2), jax.numpy.float32),
+        )
+        assert pred.shape == (1, 32, 32, 1 + 8)
+
+    async def test_odd_n_rays_rejected_synchronously(self, cellpose_app):
+        """Config validation happens in start_training itself — before
+        the expensive target derivation runs — not asynchronously in
+        the train thread."""
+        result, server = cellpose_app
+        sid = result["service_id"]
+        images, masks = _synthetic_cells()
+        with pytest.raises(Exception, match="n_rays must be even"):
+            await call(
+                server, sid, "start_training",
+                train_images=images, train_labels=masks,
+                config={**self.CFG, "n_rays": 7},
+                session_id="stardist-odd",
+            )
+
+
 CPSAM_TINY = {
     "patch_size": 8,
     "dim": 32,
