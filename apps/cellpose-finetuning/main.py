@@ -93,11 +93,13 @@ def _merge_config(config: Optional[dict]) -> dict:
         for k, v in _CPSAM_ARCH_DEFAULTS.items():
             if k not in config:
                 cfg[k] = v
-    if cfg.get("backbone") == "stardist" and int(cfg["n_rays"]) % 2:
-        # reject HERE, synchronously in start_training — target
-        # derivation is the expensive step and must not run for a
-        # config the train loop would refuse anyway
-        raise ValueError(f"n_rays must be even, got {cfg['n_rays']}")
+    if cfg.get("backbone") == "stardist":
+        n_rays = int(cfg["n_rays"])
+        if n_rays < 2 or n_rays % 2:
+            # reject HERE, synchronously in start_training — target
+            # derivation is the expensive step and must not run for a
+            # config the train loop would refuse anyway
+            raise ValueError(f"n_rays must be even and >= 2, got {n_rays}")
     return cfg
 
 
@@ -150,9 +152,16 @@ def _check_pretrained_tree(params: dict, expect: dict) -> None:
 
 
 def build_model(cfg: dict):
-    """(model, divisor) for the configured backbone — both emit the same
-    (B, H, W, 3) flow/cellprob logits, so the train step, loss, flows
-    postprocessing, and export path are backbone-agnostic."""
+    """(model, divisor) for the configured backbone.
+
+    The cellpose family (unet/sam/cpsam) shares one output contract —
+    (B, H, W, 3) flow/cellprob logits — so its train step, loss, and
+    flow postprocessing are backbone-agnostic. The stardist backbone
+    emits (B, H, W, 1 + n_rays) prob/ray logits instead: adding a
+    backbone with its own output contract means wiring ALL of
+    _prepare_training_data (targets), _train_loop (step + aug),
+    _infer (postprocessing), and infer_3d (support or reject), the way
+    the stardist branches in each of those do."""
     backbone = cfg.get("backbone", "unet")
     if backbone == "cpsam":
         from bioengine_tpu.models.sam import CpSAM
@@ -802,11 +811,13 @@ class CellposeFinetune:
         return load_params_npz(str(session.latest_path))
 
     def _predict_raw(self, session, x: np.ndarray, params=None) -> np.ndarray:
-        """(N, H, W, 2) prepared batch -> (N, H, W, 3) raw network
-        output (dy, dx, cellprob logits). ``params`` preloaded via
-        ``_load_snapshot`` keeps multi-pass callers (infer_3d's three
-        orientations) on ONE snapshot even while training is writing
-        new ones; None loads the latest."""
+        """(N, H, W, 2) prepared batch -> raw network output:
+        (N, H, W, 3) (dy, dx, cellprob logits) for cellpose-family
+        backbones, (N, H, W, 1 + n_rays) (prob logit, ray distances)
+        for stardist. ``params`` preloaded via ``_load_snapshot`` keeps
+        multi-pass callers (infer_3d's three orientations) on ONE
+        snapshot even while training is writing new ones; None loads
+        the latest."""
         import jax
 
         from bioengine_tpu.runtime.buckets import bucket_shape, crop_to, pad_to
@@ -1003,19 +1014,25 @@ class CellposeFinetune:
         session = self._get_session(session_id)
         if not session.latest_path.exists():
             raise RuntimeError(f"session '{session_id}' has no snapshot")
-        name = model_name or f"cellpose-{session_id}"
+        cfg = session.config
+        stardist = cfg.get("backbone") == "stardist"
+        family = "stardist" if stardist else "cellpose"
+        name = model_name or f"{family}-{session_id}"
         export_dir = self.sessions_root / "exports" / name
         export_dir.mkdir(parents=True, exist_ok=True)
         shutil.copyfile(session.latest_path, export_dir / "weights.npz")
-        cfg = session.config
         rdf = {
             "type": "model",
             "name": name,
             "description": (
-                f"Cellpose flow-field model fine-tuned in BioEngine-TPU "
-                f"session {session_id}"
+                f"StarDist star-convex polygon model (prob + "
+                f"{cfg.get('n_rays')} ray distances) fine-tuned in "
+                f"BioEngine-TPU session {session_id}"
+                if stardist
+                else f"Cellpose flow-field model fine-tuned in "
+                f"BioEngine-TPU session {session_id}"
             ),
-            "tags": ["cellpose", "segmentation", "fine-tuned"],
+            "tags": [family, "segmentation", "fine-tuned"],
             "inputs": [{"name": "input0", "axes": "byxc"}],
             "outputs": [{"name": "output0", "axes": "byxc"}],
             "weights": {
