@@ -94,12 +94,16 @@ def _merge_config(config: Optional[dict]) -> dict:
             if k not in config:
                 cfg[k] = v
     if cfg.get("backbone") == "stardist":
-        n_rays = int(cfg["n_rays"])
-        if n_rays < 2 or n_rays % 2:
+        n_rays = float(cfg["n_rays"])
+        if not n_rays.is_integer() or n_rays < 2 or int(n_rays) % 2:
             # reject HERE, synchronously in start_training — target
             # derivation is the expensive step and must not run for a
-            # config the train loop would refuse anyway
-            raise ValueError(f"n_rays must be even and >= 2, got {n_rays}")
+            # config the train loop would refuse anyway (and int()
+            # truncation must not silently accept 8.9 as 8)
+            raise ValueError(
+                f"n_rays must be an even integer >= 2, got {cfg['n_rays']}"
+            )
+        cfg["n_rays"] = int(n_rays)
     return cfg
 
 

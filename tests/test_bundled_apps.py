@@ -1049,13 +1049,69 @@ class TestStardistBackbone:
         result, server = cellpose_app
         sid = result["service_id"]
         images, masks = _synthetic_cells()
-        with pytest.raises(Exception, match="n_rays must be even"):
+        with pytest.raises(Exception, match="n_rays must be an even"):
             await call(
                 server, sid, "start_training",
                 train_images=images, train_labels=masks,
                 config={**self.CFG, "n_rays": 7},
                 session_id="stardist-odd",
             )
+
+
+class TestFinetuneExportServedByModelRunner:
+    """Cross-app path the reference implements via the BioImage Model
+    Zoo: a model fine-tuned in one app is exported and served by the
+    model-runner (ref main.py:4413+ uploads to the zoo; here the
+    export directory IS a collection entry)."""
+
+    async def test_stardist_export_roundtrips_through_model_runner(
+        self, cellpose_app, stack, tmp_path, monkeypatch
+    ):
+        result, server = cellpose_app
+        sid = result["service_id"]
+        images, masks = _synthetic_cells()
+        cfg = {
+            "backbone": "stardist", "features": [8, 16], "n_rays": 8,
+            "epochs": 2, "batch_size": 4, "tile": 32,
+            "learning_rate": 1e-3,
+        }
+        await call(
+            server, sid, "start_training",
+            train_images=images, train_labels=masks, config=cfg,
+            session_id="sd-export",
+        )
+        final = await wait_for_status(
+            server, sid, "sd-export", {"completed", "failed"}
+        )
+        assert final["status"] == "completed", final.get("error")
+        exported = await call(
+            server, sid, "export_model", session_id="sd-export",
+            model_name="sd-served",
+        )
+
+        # the export dir is a collection entry: point the model-runner
+        # at its parent and serve it
+        collection = Path(exported["model_path"]).parent
+        monkeypatch.setenv("BIOENGINE_LOCAL_MODEL_PATH", str(collection))
+        manager, _, _, _ = stack
+        mr = await deploy(
+            manager,
+            "model-runner",
+            deployment_kwargs={
+                "entry_deployment": {
+                    "cache_dir": str(tmp_path / "model-cache")
+                }
+            },
+        )
+        x = np.stack(
+            [images[0], np.zeros_like(images[0])], axis=-1
+        )[None].astype(np.float32)
+        out = await call(
+            server, mr["service_id"], "infer",
+            model_id="sd-served", inputs=x,
+        )
+        assert out["_meta"]["backend"] == "xla"
+        assert np.asarray(out["output0"]).shape == (1, 64, 64, 9)
 
 
 CPSAM_TINY = {
