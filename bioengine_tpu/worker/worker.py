@@ -103,6 +103,11 @@ class BioEngineWorker:
     async def start(self, blocking: bool = False) -> dict:
         """Bring the worker up (ref worker.py:925-1001). Returns the
         service endpoints."""
+        from bioengine_tpu.utils.compile_cache import (
+            enable_persistent_compilation_cache,
+        )
+
+        enable_persistent_compilation_cache()
         self.start_time = time.time()
         self.cluster.start()
         await self.server.start()

@@ -328,6 +328,11 @@ def main(argv: Optional[list[str]] = None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    from bioengine_tpu.utils.compile_cache import (
+        enable_persistent_compilation_cache,
+    )
+
+    enable_persistent_compilation_cache()
 
     async def run() -> int:
         host = WorkerHost(

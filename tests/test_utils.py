@@ -320,3 +320,44 @@ class TestTracing:
         assert len(get_spans(name="a")) == 5
         assert len(get_spans(max_spans=3)) == 3
         assert get_spans(name="a")[-1]["attrs"] == {"i": 4}
+
+
+def test_persistent_compilation_cache(tmp_path):
+    """enable_persistent_compilation_cache fills the cache dir and a
+    second process reuses it (subprocess: jax config is process-global
+    and must not leak into other tests)."""
+    import subprocess
+    import sys
+
+    prog = f"""
+import jax; jax.config.update("jax_platforms", "cpu")
+from bioengine_tpu.utils.compile_cache import enable_persistent_compilation_cache
+d = enable_persistent_compilation_cache({str(tmp_path)!r})
+assert d == {str(tmp_path)!r}, d
+# idempotent
+assert enable_persistent_compilation_cache("/elsewhere") == d
+import jax.numpy as jnp
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.jit(lambda x: (x @ x).sum())(jnp.ones((64, 64))).block_until_ready()
+"""
+    for _ in range(2):
+        r = subprocess.run(
+            [sys.executable, "-c", prog], capture_output=True, text=True
+        )
+        assert r.returncode == 0, r.stderr[-1500:]
+    assert any(tmp_path.iterdir()), "cache dir stayed empty"
+
+    # explicit opt-out
+    import os
+
+    r = subprocess.run(
+        [sys.executable, "-c", (
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "from bioengine_tpu.utils.compile_cache import "
+            "enable_persistent_compilation_cache\n"
+            "assert enable_persistent_compilation_cache() is None"
+        )],
+        capture_output=True, text=True,
+        env={**os.environ, "BIOENGINE_COMPILE_CACHE": "off"},
+    )
+    assert r.returncode == 0, r.stderr[-1500:]
