@@ -206,9 +206,15 @@ class CellImageSearch:
         # fresh session dir per run
         sdir = session_dir(self.workspace_dir, session_id)
         if sdir.exists():
+            import os
             import shutil
 
-            shutil.rmtree(sdir)
+            # rename synchronously so a concurrent start for the same
+            # session_id can't pass the liveness guard mid-delete and
+            # race on the session dir; delete the renamed tree off-loop
+            doomed = sdir.with_name(f".{sdir.name}.deleting-{os.getpid()}")
+            sdir.rename(doomed)
+            await asyncio.to_thread(shutil.rmtree, doomed)
         write_status(
             self.workspace_dir, session_id,
             IngestionStatus.WAITING, "Queued",
@@ -268,7 +274,9 @@ class CellImageSearch:
         sessions = {}
         if root.exists():
             for d in sorted(root.iterdir()):
-                if d.is_dir():
+                # skip '.{name}.deleting-*' rename-away trees (crashed
+                # mid-delete) and other hidden dirs — not sessions
+                if d.is_dir() and not d.name.startswith("."):
                     sessions[d.name] = read_status(
                         self.workspace_dir, d.name
                     )

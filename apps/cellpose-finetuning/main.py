@@ -365,8 +365,11 @@ class CellposeFinetune:
             if d.name.startswith("."):
                 # a '.{name}.deleting-*' dir is a failed start_training's
                 # renamed-away tree whose threaded rmtree didn't finish
-                # (crash/restart mid-delete) — sweep it, never adopt it
-                shutil.rmtree(d, ignore_errors=True)
+                # (crash/restart mid-delete) — sweep it, never adopt it.
+                # Only OUR rename pattern: any other hidden directory
+                # (.cache, .snapshots, ...) is not ours to delete.
+                if ".deleting-" in d.name and d.is_dir():
+                    shutil.rmtree(d, ignore_errors=True)
                 continue
             if (d / "status.json").exists():
                 try:
@@ -1024,7 +1027,9 @@ class CellposeFinetune:
         name = model_name or f"{family}-{session_id}"
         export_dir = self.sessions_root / "exports" / name
         export_dir.mkdir(parents=True, exist_ok=True)
-        shutil.copyfile(session.latest_path, export_dir / "weights.npz")
+        await asyncio.to_thread(
+            shutil.copyfile, session.latest_path, export_dir / "weights.npz"
+        )
         rdf = {
             "type": "model",
             "name": name,

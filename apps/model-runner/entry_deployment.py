@@ -82,7 +82,9 @@ class LocalCollectionSource:
         ]
 
     async def fetch_file(self, model_id: str, name: str, stage: bool) -> bytes:
-        return (self.root / model_id / name).read_bytes()
+        return await asyncio.to_thread(
+            (self.root / model_id / name).read_bytes
+        )
 
     async def is_published(self, model_id: str) -> bool:
         checks = await self.inference_checks()
@@ -257,7 +259,12 @@ class ModelCache:
                 raise RuntimeError(
                     f"cannot re-download '{model_id}' while it is in use"
                 )
-            shutil.rmtree(package)
+            # rename first (sync, atomic) so no coroutine interleaving
+            # with the threaded delete can see a half-deleted package
+            # and adopt it; dot-prefix keeps it out of package listings
+            doomed = package.with_name(f".purge-{package.name}-{os.getpid()}")
+            package.rename(doomed)
+            await asyncio.to_thread(shutil.rmtree, doomed)
         if not package.exists():
             await self._download(model_id, stage, package)
         self._touch_access(package)
@@ -295,15 +302,19 @@ class ModelCache:
             await self._ensure_space(total)
             tmp = self.cache_dir / f".tmp-{model_id}-{os.getpid()}"
             if tmp.exists():
-                shutil.rmtree(tmp)
+                await asyncio.to_thread(shutil.rmtree, tmp)
             tmp.mkdir(parents=True)
             for f in files:
                 data = await self.source.fetch_file(model_id, f["name"], stage)
                 dest = tmp / f["name"]
                 dest.parent.mkdir(parents=True, exist_ok=True)
-                dest.write_bytes(data)
+                await asyncio.to_thread(dest.write_bytes, data)
             tmp.rename(package)
         except BaseException:
+            # cleanup must stay synchronous: awaiting inside a handler
+            # that may hold a CancelledError would get re-cancelled and
+            # leak the temp dir
+            # bioengine: ignore[BE-ASYNC-001]
             shutil.rmtree(
                 self.cache_dir / f".tmp-{model_id}-{os.getpid()}",
                 ignore_errors=True,
@@ -350,7 +361,12 @@ class ModelCache:
             model_id = p.name.removesuffix("-staged")
             if self._in_use.get(model_id) or model_id in disk_in_use:
                 continue
-            shutil.rmtree(p)
+            # sync rename, threaded delete: the in-use / exists checks
+            # above stay atomic w.r.t. the event loop (no adoption of a
+            # half-deleted package during the await)
+            doomed = p.with_name(f".evict-{p.name}-{os.getpid()}")
+            p.rename(doomed)
+            await asyncio.to_thread(shutil.rmtree, doomed)
             current -= used[p]
         # best-effort budget: if every remaining package is in use the
         # cache overflows temporarily rather than failing the download
@@ -603,7 +619,7 @@ class EntryDeployment:
         if not dest.is_relative_to(self._uploads_dir.resolve()):
             raise ValueError("file_path escapes the upload area")
         dest.parent.mkdir(parents=True, exist_ok=True)
-        dest.write_bytes(bytes(data))
+        await asyncio.to_thread(dest.write_bytes, bytes(data))
         return {"file_path": file_path, "size": len(data)}
 
     async def _load_image_from_source(self, source: str) -> np.ndarray:
@@ -626,7 +642,7 @@ class EntryDeployment:
                 raise FileNotFoundError(
                     f"uploaded file '{source}' not found or expired"
                 )
-            raw, name = path.read_bytes(), str(path)
+            raw, name = await asyncio.to_thread(path.read_bytes), str(path)
         return self._decode_array(raw, name)
 
     @staticmethod

@@ -1,0 +1,49 @@
+"""Static analysis for BioEngine-TPU: async-safety + JAX tracer-safety.
+
+The orchestration layer (RPC server, proxies, worker monitor loop) is
+asyncio end to end, and the compute layer is jitted JAX — the two bug
+classes that slip past unit tests are *blocking calls / unguarded
+shared state inside the event loop* and *silent tracer-safety
+violations inside jitted code*.  This package catches both statically:
+
+- :mod:`bioengine_tpu.analysis.core` — AST-walker framework, rule
+  registry, ``# bioengine: ignore[RULE]`` suppressions.
+- :mod:`bioengine_tpu.analysis.async_rules` — BE-ASYNC-* rules.
+- :mod:`bioengine_tpu.analysis.jax_rules` — BE-JAX-* rules.
+- :mod:`bioengine_tpu.analysis.baseline` — checked-in baseline so
+  pre-existing, justified findings don't block CI.
+
+Run it as ``python -m bioengine_tpu.analysis <paths>`` or
+``bioengine analyze``.  See docs/static-analysis.md for the rule
+catalog.
+"""
+
+from bioengine_tpu.analysis.core import (
+    Finding,
+    Rule,
+    all_rules,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+    get_rule,
+)
+from bioengine_tpu.analysis.baseline import (
+    Baseline,
+    fingerprint,
+)
+
+# Importing the rule modules registers their rules with the registry.
+from bioengine_tpu.analysis import async_rules as _async_rules  # noqa: F401
+from bioengine_tpu.analysis import jax_rules as _jax_rules  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "Baseline",
+    "all_rules",
+    "analyze_file",
+    "analyze_paths",
+    "analyze_source",
+    "fingerprint",
+    "get_rule",
+]

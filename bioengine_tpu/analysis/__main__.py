@@ -1,0 +1,225 @@
+"""``python -m bioengine_tpu.analysis`` — CLI for the static analyzer.
+
+Exit codes: 0 clean (or all findings baselined/suppressed), 1 findings,
+2 usage/internal error.  ``bioengine analyze`` wraps this entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from bioengine_tpu.analysis import (
+    Baseline,
+    all_rules,
+    analyze_paths,
+)
+from bioengine_tpu.analysis.baseline import (
+    DEFAULT_BASELINE,
+    TODO_JUSTIFICATION,
+)
+
+
+def _git_changed_files(ref: str) -> list[Path] | None:
+    """Tracked files changed vs ``ref`` plus untracked files, or None
+    when git is unavailable (caller falls back to a full scan).
+
+    git emits repo-root-relative names; anchor them at the toplevel so
+    ``--changed`` works from any working directory, not just the root.
+    """
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True,
+            text=True,
+            check=True,
+            timeout=30,
+        ).stdout.strip()
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "--diff-filter=ACMR", ref, "--"],
+            capture_output=True,
+            text=True,
+            check=True,
+            timeout=30,
+            cwd=top,
+        )
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            capture_output=True,
+            text=True,
+            check=True,
+            timeout=30,
+            cwd=top,  # --others is cwd-scoped: scope it to the whole repo
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    names = set(diff.stdout.splitlines()) | set(untracked.stdout.splitlines())
+    return [Path(top) / n for n in sorted(names) if n.endswith(".py")]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m bioengine_tpu.analysis",
+        description="BioEngine async-safety + JAX tracer-safety linter",
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        default=["bioengine_tpu", "apps"],
+        help="files/directories to scan (default: bioengine_tpu apps)",
+    )
+    p.add_argument(
+        "--baseline",
+        type=Path,
+        default=None,
+        help=f"baseline file (default: {DEFAULT_BASELINE} when present)",
+    )
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore any baseline file",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="record current findings into the baseline and exit 0",
+    )
+    p.add_argument(
+        "--changed",
+        nargs="?",
+        const="HEAD",
+        default=None,
+        metavar="REF",
+        help="scan only files changed vs REF (default HEAD) + untracked, "
+        "intersected with PATHS — keeps the CI gate fast",
+    )
+    p.add_argument(
+        "--rule",
+        action="append",
+        default=None,
+        metavar="RULE-ID",
+        help="restrict to specific rule id(s); repeatable",
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+    )
+    p.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule catalog and exit",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.id}  {r.slug:32s} [{r.pass_name}] {r.summary}")
+        return 0
+
+    scan_paths = [Path(p) for p in args.paths]
+    missing = [p for p in scan_paths if not p.exists()]
+    if missing:
+        print(
+            f"error: no such path(s): {', '.join(map(str, missing))}",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.changed is not None:
+        changed = _git_changed_files(args.changed)
+        if changed is None:
+            print(
+                "warning: git unavailable, falling back to full scan",
+                file=sys.stderr,
+            )
+        else:
+            roots = [p.resolve() for p in scan_paths]
+            scan_paths = [
+                f
+                for f in changed
+                if f.exists()
+                and any(
+                    f.resolve() == r or r in f.resolve().parents
+                    for r in roots
+                )
+            ]
+            if not scan_paths:
+                print("analyze: no changed python files in scope")
+                return 0
+
+    rules = set(args.rule) if args.rule else None
+    findings = analyze_paths(scan_paths, rules=rules)
+
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    baseline = Baseline()
+    if not args.no_baseline and baseline_path.exists():
+        try:
+            baseline = Baseline.load(baseline_path)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: bad baseline {baseline_path}: {e}", file=sys.stderr)
+            return 2
+
+    if args.write_baseline:
+        baseline.update_from(findings)
+        baseline.save(baseline_path)
+        todo = sum(
+            1
+            for e in baseline.entries.values()
+            if e["justification"] == TODO_JUSTIFICATION
+        )
+        print(
+            f"wrote {len(baseline.entries)} finding(s) to {baseline_path}"
+            + (f" — {todo} need a justification" if todo else "")
+        )
+        return 0
+
+    new, stale = baseline.apply(findings)
+    # --changed scans a subset of files, so absent baselined findings
+    # are expected — only report staleness on a full scan.
+    if stale and args.changed is None:
+        print(
+            f"warning: {len(stale)} stale baseline entr"
+            f"{'y' if len(stale) == 1 else 'ies'} (fixed findings) — "
+            f"prune with --write-baseline",
+            file=sys.stderr,
+        )
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                [
+                    {
+                        "rule": f.rule,
+                        "path": f.path,
+                        "line": f.line,
+                        "col": f.col,
+                        "message": f.message,
+                    }
+                    for f in new
+                ],
+                indent=2,
+            )
+        )
+    else:
+        for f in new:
+            print(f.render())
+        suppressed = len(findings) - len(new)
+        tail = f" ({suppressed} baselined)" if suppressed else ""
+        print(
+            f"analyze: {len(new)} finding(s){tail}"
+            if new
+            else f"analyze: clean{tail}"
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,507 @@
+"""JAX tracer-safety pass (BE-JAX-*): silent hazards inside jitted code.
+
+Targets the compute layer (ops/, models/, parallel/, runtime/engine.py)
+where functions run under ``jax.jit`` / ``pmap`` / ``shard_map``.
+Inside a traced function, Python control flow on traced values raises
+(or worse, silently bakes in one branch), host ``np.*`` calls force a
+device sync and break AD, ``.item()``/``float()`` coercions raise
+``ConcretizationTypeError`` only at call time, and mutation of
+closed-over state executes once at trace time and never again.
+
+Jitted functions are found two ways:
+
+1. decorator style — ``@jax.jit``, ``@jit``, ``@pmap``,
+   ``@functools.partial(jax.jit, static_argnums=...)``, shard_map
+   variants;
+2. call style — ``jax.jit(fn, static_argnames=...)`` anywhere in the
+   module where ``fn`` is a function defined in the same module (the
+   dominant idiom in parallel/ and runtime/engine.py).
+
+Parameters named by ``static_argnums`` / ``static_argnames`` (and
+``pmap``'s ``static_broadcasted_argnums``) are concrete at trace time
+and are excluded from the traced set.  ``.shape``/``.ndim``/``.dtype``
+attribute access and ``len()`` on traced arrays are static and never
+flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+from bioengine_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    dotted_name,
+    register_pass,
+    register_rule,
+)
+
+TRACED_BRANCH = register_rule(
+    Rule(
+        "BE-JAX-101",
+        "traced-python-branch",
+        "Python if/while on a traced value inside a jitted function",
+        "jax",
+    )
+)
+NUMPY_ON_TRACED = register_rule(
+    Rule(
+        "BE-JAX-102",
+        "numpy-call-on-traced",
+        "Host numpy call on a traced value inside a jitted function",
+        "jax",
+    )
+)
+TRACED_COERCION = register_rule(
+    Rule(
+        "BE-JAX-103",
+        "traced-coercion",
+        ".item()/float()/int()/bool() on a traced value under jit",
+        "jax",
+    )
+)
+CLOSURE_MUTATION = register_rule(
+    Rule(
+        "BE-JAX-104",
+        "closure-mutation-under-jit",
+        "Mutation of closed-over/global state inside a jitted function",
+        "jax",
+    )
+)
+NONSTATIC_SHAPE = register_rule(
+    Rule(
+        "BE-JAX-105",
+        "nonstatic-shape-arg",
+        "Traced value used as a shape argument; missing static_argnums",
+        "jax",
+    )
+)
+
+_JIT_NAMES = {
+    "jax.jit",
+    "jit",
+    "jax.pmap",
+    "pmap",
+    "jax.shard_map",
+    "shard_map",
+    "jax.experimental.shard_map.shard_map",
+}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+_STATIC_KWARGS = {
+    "static_argnums",
+    "static_argnames",
+    "static_broadcasted_argnums",
+}
+
+# Dotted callables whose *shape* argument must be concrete.  Value is
+# the positional index of the shape parameter.
+_SHAPE_ARG_FNS = {
+    "jnp.zeros": 0,
+    "jnp.ones": 0,
+    "jnp.empty": 0,
+    "jnp.full": 0,
+    "jnp.eye": 0,
+    "jnp.arange": 0,
+    "jnp.linspace": 2,  # num
+    "jnp.reshape": 1,
+    "jnp.broadcast_to": 1,
+    "jax.numpy.zeros": 0,
+    "jax.numpy.ones": 0,
+    "jax.numpy.reshape": 1,
+    "jax.numpy.broadcast_to": 1,
+}
+
+_MUTATOR_METHODS = {
+    "append",
+    "extend",
+    "insert",
+    "add",
+    "update",
+    "setdefault",
+    "remove",
+    "discard",
+    "clear",
+    "popitem",
+}
+
+# Builtins that are static/identity-level even on traced arrays.
+_STATIC_BUILTINS = {
+    "len",
+    "isinstance",
+    "type",
+    "getattr",
+    "hasattr",
+    "callable",
+    "id",
+}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+
+
+@dataclass
+class JittedFn:
+    node: ast.FunctionDef
+    traced: set[str]
+    how: str  # "decorator" | "call"
+    locals_: set[str] = field(default_factory=set)
+
+
+# ---------------------------------------------------------------------------
+# Discovery
+# ---------------------------------------------------------------------------
+
+
+def _static_names_from_call(
+    call: ast.Call, fn: ast.FunctionDef
+) -> Optional[set[str]]:
+    """Param names made static by static_argnums/static_argnames kwargs.
+
+    Returns None when a static spec exists but can't be resolved to
+    literal names/indices (dynamic spec) — caller should then treat
+    *all* params as potentially static and skip the function rather
+    than raise false positives.
+    """
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    out: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg not in _STATIC_KWARGS:
+            continue
+        values: list[ast.expr]
+        if isinstance(kw.value, (ast.Tuple, ast.List, ast.Set)):
+            values = list(kw.value.elts)
+        else:
+            values = [kw.value]
+        for v in values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out.add(v.value)
+            elif isinstance(v, ast.Constant) and isinstance(v.value, int):
+                if 0 <= v.value < len(params):
+                    out.add(params[v.value])
+            else:
+                return None  # dynamic spec — bail out conservatively
+    return out
+
+
+def _traced_params(fn: ast.FunctionDef, static: set[str]) -> set[str]:
+    args = fn.args
+    names = [
+        a.arg for a in args.posonlyargs + args.args + args.kwonlyargs
+    ]
+    traced = {n for n in names if n not in static and n not in {"self", "cls"}}
+    return traced
+
+
+def _jit_spec_from_decorator(dec: ast.expr) -> Optional[ast.Call]:
+    """Return the Call carrying static kwargs (or a synthetic marker)
+    if this decorator makes the function jitted, else None."""
+    name = dotted_name(dec)
+    if name in _JIT_NAMES:
+        return ast.Call(func=dec, args=[], keywords=[])  # no static kwargs
+    if isinstance(dec, ast.Call):
+        fname = dotted_name(dec.func)
+        if fname in _JIT_NAMES:
+            return dec  # @jax.jit(static_argnums=...) factory style
+        if fname in _PARTIAL_NAMES and dec.args:
+            inner = dotted_name(dec.args[0])
+            if inner in _JIT_NAMES:
+                return dec  # @partial(jax.jit, static_argnames=...)
+    return None
+
+
+def _discover_jitted(tree: ast.Module) -> list[JittedFn]:
+    fns = {
+        n.name: n
+        for n in ast.walk(tree)
+        if isinstance(n, ast.FunctionDef)
+    }
+    out: list[JittedFn] = []
+    seen: set[str] = set()
+
+    # decorator style
+    for fn in fns.values():
+        for dec in fn.decorator_list:
+            spec = _jit_spec_from_decorator(dec)
+            if spec is None:
+                continue
+            static = _static_names_from_call(spec, fn)
+            if static is None:
+                break  # unresolvable static spec: skip the function
+            out.append(JittedFn(fn, _traced_params(fn, static), "decorator"))
+            seen.add(fn.name)
+            break
+
+    # call style: jax.jit(fn, ...) / shard_map(fn, ...) over a local def
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if dotted_name(node.func) not in _JIT_NAMES or not node.args:
+            continue
+        target = node.args[0]
+        if not isinstance(target, ast.Name) or target.id not in fns:
+            continue
+        if target.id in seen:
+            continue
+        fn = fns[target.id]
+        static = _static_names_from_call(node, fn)
+        if static is None:
+            continue
+        out.append(JittedFn(fn, _traced_params(fn, static), "call"))
+        seen.add(target.id)
+
+    for jf in out:
+        jf.locals_ = _collect_locals(jf.node)
+    return out
+
+
+def _collect_locals(fn: ast.FunctionDef) -> set[str]:
+    """Names assigned anywhere in the function (params included)."""
+    args = fn.args
+    names = {
+        a.arg
+        for a in args.posonlyargs
+        + args.args
+        + args.kwonlyargs
+        + ([args.vararg] if args.vararg else [])
+        + ([args.kwarg] if args.kwarg else [])
+    }
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node is not fn:
+                names.add(node.name)
+            continue
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            targets = [node.target]
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            targets = [
+                item.optional_vars
+                for item in node.items
+                if item.optional_vars is not None
+            ]
+        elif isinstance(node, ast.comprehension):
+            targets = [node.target]
+        for t in targets:
+            _bind_target(t, names)
+    return names
+
+
+def _bind_target(t: ast.expr, names: set[str]) -> None:
+    """Add names a target *binds*.  ``x[k] = v`` / ``x.a = v`` mutate an
+    existing object — they bind nothing, so they must not make ``x``
+    local (that would hide closure mutations from BE-JAX-104)."""
+    if isinstance(t, ast.Name):
+        names.add(t.id)
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            _bind_target(e, names)
+    elif isinstance(t, ast.Starred):
+        _bind_target(t.value, names)
+
+
+# ---------------------------------------------------------------------------
+# Traced-value reference analysis
+# ---------------------------------------------------------------------------
+
+
+def _naked_traced_refs(expr: ast.AST, traced: set[str]) -> set[str]:
+    """Traced names referenced *as values* (not via static metadata).
+
+    ``x.shape[0] > 4`` is static; ``x > 4`` is a tracer op.  Identity
+    comparisons (``x is None``) and static builtins (``len(x)``,
+    ``isinstance(x, ...)``) are excluded.
+    """
+    refs: set[str] = set()
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return
+            visit(node.value)
+            return
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            if fname in _STATIC_BUILTINS:
+                return
+            visit(node.func)
+            for a in node.args:
+                visit(a)
+            for kw in node.keywords:
+                visit(kw.value)
+            return
+        if isinstance(node, ast.Compare):
+            ops_static = all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+            )
+            if ops_static:
+                return
+        if isinstance(node, ast.Name):
+            if node.id in traced:
+                refs.add(node.id)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(expr)
+    return refs
+
+
+# ---------------------------------------------------------------------------
+# The pass
+# ---------------------------------------------------------------------------
+
+
+def run_jax_pass(ctx: ModuleContext) -> Iterator[Finding]:
+    for jf in _discover_jitted(ctx.tree):
+        yield from _check_jitted_fn(ctx, jf)
+
+
+def _check_jitted_fn(ctx: ModuleContext, jf: JittedFn) -> Iterator[Finding]:
+    fn, traced = jf.node, jf.traced
+    for node in ast.walk(fn):
+        # --- Python control flow on traced values ---------------------
+        if isinstance(node, (ast.If, ast.While)):
+            refs = _naked_traced_refs(node.test, traced)
+            if refs:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                yield ctx.finding(
+                    TRACED_BRANCH.id,
+                    node,
+                    f"Python `{kind}` on traced value(s) "
+                    f"{sorted(refs)} in jitted `{fn.name}` — raises "
+                    f"ConcretizationTypeError at trace time; use "
+                    f"`jax.lax.cond`/`jnp.where` (or mark the argument "
+                    f"static)",
+                )
+
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func) or ""
+
+            # --- host numpy on traced values --------------------------
+            if fname.startswith(("np.", "numpy.")):
+                hit = set()
+                for a in list(node.args) + [kw.value for kw in node.keywords]:
+                    hit |= _naked_traced_refs(a, traced)
+                if hit:
+                    yield ctx.finding(
+                        NUMPY_ON_TRACED.id,
+                        node,
+                        f"host `{fname}()` applied to traced value(s) "
+                        f"{sorted(hit)} in jitted `{fn.name}` — forces a "
+                        f"device sync or trace error; use the `jnp.` "
+                        f"equivalent",
+                    )
+
+            # --- concretizing coercions -------------------------------
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in {"float", "int", "bool"}
+                and node.args
+            ):
+                hit = _naked_traced_refs(node.args[0], traced)
+                if hit:
+                    yield ctx.finding(
+                        TRACED_COERCION.id,
+                        node,
+                        f"`{node.func.id}()` concretizes traced value(s) "
+                        f"{sorted(hit)} in jitted `{fn.name}` — raises "
+                        f"under jit; keep it as an array (`.astype`) or "
+                        f"return it instead",
+                    )
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in {"item", "tolist"}
+            ):
+                hit = _naked_traced_refs(node.func.value, traced)
+                if hit:
+                    yield ctx.finding(
+                        TRACED_COERCION.id,
+                        node,
+                        f"`.{node.func.attr}()` on traced value(s) "
+                        f"{sorted(hit)} in jitted `{fn.name}` — raises "
+                        f"ConcretizationTypeError under jit",
+                    )
+
+            # --- mutating a closed-over container ---------------------
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATOR_METHODS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id not in jf.locals_
+            ):
+                yield ctx.finding(
+                    CLOSURE_MUTATION.id,
+                    node,
+                    f"`{node.func.value.id}.{node.func.attr}(...)` mutates "
+                    f"closed-over state in jitted `{fn.name}` — runs once "
+                    f"at trace time, then never again on cached calls",
+                )
+
+            # --- traced shape arguments -------------------------------
+            yield from _check_shape_call(ctx, jf, node, fname)
+
+        # --- global/nonlocal rebinding under jit ----------------------
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            kw = "global" if isinstance(node, ast.Global) else "nonlocal"
+            yield ctx.finding(
+                CLOSURE_MUTATION.id,
+                node,
+                f"`{kw} {', '.join(node.names)}` in jitted `{fn.name}` — "
+                f"rebinding outer state under jit happens at trace time "
+                f"only; thread it through the return value instead",
+            )
+
+        # --- subscript-assign into closed-over container --------------
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id not in jf.locals_
+                ):
+                    yield ctx.finding(
+                        CLOSURE_MUTATION.id,
+                        node,
+                        f"`{t.value.id}[...] = ...` writes into closed-"
+                        f"over state in jitted `{fn.name}` — trace-time "
+                        f"side effect, silently stale afterwards",
+                    )
+
+
+def _check_shape_call(
+    ctx: ModuleContext, jf: JittedFn, node: ast.Call, fname: str
+) -> Iterator[Finding]:
+    shape_args: list[ast.expr] = []
+    if fname in _SHAPE_ARG_FNS:
+        idx = _SHAPE_ARG_FNS[fname]
+        if len(node.args) > idx:
+            shape_args.append(node.args[idx])
+        for kw in node.keywords:
+            if kw.arg in {"shape", "num", "new_sizes"}:
+                shape_args.append(kw.value)
+    elif (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr == "reshape"
+    ):
+        shape_args.extend(node.args)
+
+    for arg in shape_args:
+        hit = _naked_traced_refs(arg, jf.traced)
+        if hit:
+            label = fname or f".{node.func.attr}"  # type: ignore[union-attr]
+            yield ctx.finding(
+                NONSTATIC_SHAPE.id,
+                node,
+                f"shape argument of `{label}(...)` derives from traced "
+                f"value(s) {sorted(hit)} in jitted `{jf.node.name}` — "
+                f"shapes must be concrete; add the parameter to "
+                f"`static_argnums`/`static_argnames`",
+            )
+
+
+register_pass("jax", run_jax_pass)

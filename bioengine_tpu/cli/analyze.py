@@ -1,0 +1,35 @@
+"""``bioengine analyze`` — CLI front-end for the static analyzer.
+
+Thin pass-through to :mod:`bioengine_tpu.analysis.__main__` so the
+click command and ``python -m bioengine_tpu.analysis`` share one
+argument surface and exit-code contract (0 clean, 1 findings,
+2 usage error).  Unknown options forward verbatim, so new analyzer
+flags never need a second wiring here.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import click
+
+
+@click.command(
+    "analyze",
+    context_settings={"ignore_unknown_options": True, "help_option_names": []},
+)
+@click.argument("analyzer_args", nargs=-1, type=click.UNPROCESSED)
+def analyze_command(analyzer_args: tuple[str, ...]) -> None:
+    """Run the async-safety + JAX tracer-safety linter.
+
+    Examples:
+
+      bioengine analyze bioengine_tpu/ apps/
+
+      bioengine analyze --changed origin/main
+
+      bioengine analyze --list-rules
+    """
+    from bioengine_tpu.analysis.__main__ import main as analysis_main
+
+    sys.exit(analysis_main(list(analyzer_args)))

@@ -11,6 +11,7 @@ import json
 
 import click
 
+from bioengine_tpu.cli.analyze import analyze_command
 from bioengine_tpu.cli.apps import apps_group
 from bioengine_tpu.cli.call import call_command
 from bioengine_tpu.cli.cluster import cluster_group
@@ -23,6 +24,7 @@ def main() -> None:
     """BioEngine-TPU command line interface."""
 
 
+main.add_command(analyze_command)
 main.add_command(call_command)
 main.add_command(apps_group)
 main.add_command(cluster_group)

@@ -42,8 +42,21 @@ class BesStats(ctypes.Structure):
 
 
 def _ensure_lib() -> Optional[ctypes.CDLL]:
-    """Build (once) and load the native library; None if unavailable."""
+    """Build (once) and load the native library; None if unavailable.
+
+    ``BIOENGINE_STORE_LIB`` overrides the library path without
+    triggering a build — how the CI sanitizer job (and the slow test in
+    tests/test_native_store.py) points the same binding at the
+    ASan/TSan-instrumented build from ``make -C native sanitizers``.
+    """
+    override = os.environ.get("BIOENGINE_STORE_LIB")
     with _build_lock:
+        if override:
+            # an explicit override must fail LOUDLY: silently falling
+            # back to the pure-Python store would let a sanitizer CI
+            # run go green while exercising zero native code
+            lib = ctypes.CDLL(override)
+            return _bind_abi(lib)
         if not _LIB_PATH.exists():
             if not (_NATIVE_DIR / "Makefile").exists():
                 return None
@@ -58,6 +71,10 @@ def _ensure_lib() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(str(_LIB_PATH))
         except OSError:
             return None
+    return _bind_abi(lib)
+
+
+def _bind_abi(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.bes_create.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32,
     ]

@@ -18,6 +18,7 @@ import aiohttp
 from bioengine_tpu.rpc import protocol
 from bioengine_tpu.rpc.schema import extract_schema
 from bioengine_tpu.utils.logger import create_logger
+from bioengine_tpu.utils.tasks import spawn_supervised
 
 
 class ServiceProxy:
@@ -108,7 +109,11 @@ class ServerConnection:
                                 err = RuntimeError(str(err))
                             fut.set_exception(err)
                 elif t == protocol.CALL:
-                    asyncio.create_task(self._handle_incoming_call(data))
+                    spawn_supervised(
+                        self._handle_incoming_call(data),
+                        name="rpc-incoming-call",
+                        logger=self.logger,
+                    )
                 elif t == protocol.PONG:
                     fut = self._pending.pop("__ping__", None)
                     if fut and not fut.done():

@@ -31,6 +31,7 @@ from aiohttp import WSMsgType, web
 from bioengine_tpu.rpc import protocol
 from bioengine_tpu.rpc.schema import extract_schema
 from bioengine_tpu.utils.logger import create_logger
+from bioengine_tpu.utils.tasks import spawn_supervised
 
 
 def _to_jsonable(obj: Any) -> Any:
@@ -613,7 +614,11 @@ class RpcServer:
                 )
             )
         elif t == protocol.CALL:
-            asyncio.create_task(self._handle_call(ws, info, msg))
+            spawn_supervised(
+                self._handle_call(ws, info, msg),
+                name="rpc-handle-call",
+                logger=self.logger,
+            )
         elif t == protocol.RESULT:
             fut = self._pending.get(msg.get("call_id", ""))
             if fut and not fut.done():

@@ -1,4 +1,10 @@
 from bioengine_tpu.utils.logger import create_logger
 from bioengine_tpu.utils.permissions import check_permissions, create_context
+from bioengine_tpu.utils.tasks import spawn_supervised
 
-__all__ = ["create_logger", "check_permissions", "create_context"]
+__all__ = [
+    "create_logger",
+    "check_permissions",
+    "create_context",
+    "spawn_supervised",
+]

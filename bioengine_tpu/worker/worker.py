@@ -34,6 +34,7 @@ from bioengine_tpu.rpc.server import RpcServer
 from bioengine_tpu.serving.controller import ServeController
 from bioengine_tpu.utils.logger import LOG_FILE_REGISTRY, create_logger, read_log_tail
 from bioengine_tpu.utils.permissions import check_permissions, create_context
+from bioengine_tpu.utils.tasks import spawn_supervised
 from bioengine_tpu.worker.code_executor import CodeExecutor
 
 MAX_CONSECUTIVE_MONITOR_ERRORS = 5
@@ -254,7 +255,9 @@ class BioEngineWorker:
             await asyncio.sleep(0.2)  # let the RESULT frame flush
             await self.stop()
 
-        asyncio.create_task(_deferred())
+        spawn_supervised(
+            _deferred(), name="deferred-stop", logger=self.logger
+        )
         return {"status": "stopping"}
 
     def _write_admin_token(self) -> None:

@@ -167,7 +167,9 @@ class WorkerHost:
             await self.connection.disconnect()
             self.connection = None
         if self._owns_workspace:
-            shutil.rmtree(self.workspace_dir, ignore_errors=True)
+            await asyncio.to_thread(
+                shutil.rmtree, self.workspace_dir, ignore_errors=True
+            )
         self._stop_event.set()
 
     def shutdown(self) -> dict:

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# CI job: static-analysis gate (async-safety + JAX tracer-safety).
+#
+# Blocking: any finding not covered by .analyze-baseline.json fails the
+# job.  On pull requests pass the base ref as $1 (e.g. origin/main) to
+# scan only changed files — the gate stays fast as the repo grows; the
+# push-to-main run does the full scan so baseline drift can't hide.
+#
+# Run locally from the repo root:  scripts/workflows/analyze.sh
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+BASE_REF="${1:-}"
+
+if [[ -n "$BASE_REF" ]]; then
+    echo "analyze: diff-aware scan vs $BASE_REF"
+    python -m bioengine_tpu.analysis bioengine_tpu/ apps/ --changed "$BASE_REF"
+else
+    echo "analyze: full scan"
+    python -m bioengine_tpu.analysis bioengine_tpu/ apps/
+fi

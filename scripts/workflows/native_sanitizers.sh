@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# CI job: build the native object store under ASan and TSan and run the
+# store test suite against each instrumented library.
+#
+# ASan and TSan cannot share one binary, so this runs the suite twice.
+# The python interpreter itself is uninstrumented, so the sanitizer
+# runtime must be LD_PRELOADed; CPython's own (intentional) allocation
+# leaks would drown the report, so leak detection is off — ASan still
+# traps heap overflow / use-after-free in object_store.cpp, and TSan
+# reports data races on the shm segment.
+#
+# Run locally from the repo root:  scripts/workflows/native_sanitizers.sh
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+make -C native sanitizers
+
+run_suite() {
+    local san="$1" kfilter="$2" runtime lib
+    runtime="$(gcc -print-file-name=lib${san}.so)"
+    lib="$PWD/native/build/libbioengine_store_${san}.so"
+    # gcc echoes the bare name back when the runtime isn't installed —
+    # fail here rather than letting LD_PRELOAD silently no-op
+    if [[ "$runtime" != /* ]]; then
+        echo "error: lib${san}.so runtime not found (gcc returned '$runtime')" >&2
+        exit 1
+    fi
+    echo "== native store suite under ${san} (preload ${runtime})"
+    # -m 'not slow': the slow sanitizer test spawns its own preloaded
+    # subprocess — redundant here where the whole suite already runs
+    # against the instrumented library
+    env LD_PRELOAD="$runtime" \
+        BIOENGINE_STORE_LIB="$lib" \
+        ASAN_OPTIONS="detect_leaks=0" \
+        TSAN_OPTIONS="halt_on_error=1" \
+        JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_native_store.py -q -m 'not slow' \
+        -k "$kfilter" -p no:cacheprovider
+}
+
+run_suite asan ""
+# TSan deadlocks in multiprocessing's spawn startup (fork + TSan's
+# internal locks), hanging the cross-process test before exec.  TSan's
+# job here is intra-process race detection on the shm segment (the
+# allocator stress + concurrency tests); cross-process visibility is
+# covered by the ASan leg and the regular suite.
+run_suite tsan "not cross_process"

@@ -1,0 +1,46 @@
+"""Seeded violations for BE-ASYNC-001 (blocking call in async def).
+
+Marker comments (``# <- RULE-ID``) name the line each rule must fire
+on; tests/test_analysis.py parses them and asserts exact positions.
+"""
+
+import asyncio
+import subprocess
+import time
+
+
+async def bad_sleep():
+    time.sleep(1.0)  # <- BE-ASYNC-001
+
+
+async def bad_subprocess():
+    subprocess.run(["ls"])  # <- BE-ASYNC-001
+
+
+async def bad_requests():
+    import requests
+
+    requests.get("http://example.com")  # <- BE-ASYNC-001
+
+
+# --- negatives -------------------------------------------------------------
+
+
+def sync_sleep_is_fine():
+    time.sleep(1.0)  # sync context: not the event loop's problem
+
+
+async def async_sleep_is_fine():
+    await asyncio.sleep(1.0)
+
+
+async def to_thread_is_fine():
+    # function *reference* passed to a thread — not called in the loop
+    await asyncio.to_thread(time.sleep, 1.0)
+
+
+async def nested_sync_def_is_fine():
+    def helper():
+        time.sleep(0.1)  # runs wherever helper is called, not here
+
+    await asyncio.to_thread(helper)

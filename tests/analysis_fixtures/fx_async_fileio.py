@@ -1,0 +1,29 @@
+"""Seeded violations for BE-ASYNC-005 (blocking file I/O in async def)."""
+
+import asyncio
+from pathlib import Path
+
+
+async def bad_open():
+    with open("config.json") as f:  # <- BE-ASYNC-005
+        return f.read()
+
+
+async def bad_path_read():
+    return Path("status.json").read_text()  # <- BE-ASYNC-005
+
+
+async def bad_path_write(payload: bytes):
+    Path("out.bin").write_bytes(payload)  # <- BE-ASYNC-005
+
+
+# --- negatives -------------------------------------------------------------
+
+
+def sync_open_is_fine():
+    with open("config.json") as f:
+        return f.read()
+
+
+async def to_thread_read_is_fine():
+    return await asyncio.to_thread(Path("status.json").read_text)

@@ -1,0 +1,37 @@
+"""Seeded violations for BE-ASYNC-003 (fire-and-forget create_task)."""
+
+import asyncio
+
+
+async def work():
+    await asyncio.sleep(0.1)
+
+
+async def bad_fire_and_forget():
+    asyncio.create_task(work())  # <- BE-ASYNC-003
+
+
+async def bad_ensure_future():
+    asyncio.ensure_future(work())  # <- BE-ASYNC-003
+
+
+async def bad_loop_create_task():
+    loop = asyncio.get_running_loop()
+    loop.create_task(work())  # <- BE-ASYNC-003
+
+
+# --- negatives -------------------------------------------------------------
+
+
+async def kept_reference_is_fine():
+    task = asyncio.create_task(work())
+    await task
+
+
+async def done_callback_is_fine():
+    asyncio.create_task(work()).add_done_callback(lambda t: t.exception())
+
+
+async def stored_in_set_is_fine():
+    tasks = set()
+    tasks.add(asyncio.create_task(work()))

@@ -1,0 +1,41 @@
+"""Seeded violations for BE-ASYNC-002 (threading lock across await)."""
+
+import asyncio
+import threading
+
+_lock = threading.Lock()
+_alock = asyncio.Lock()
+
+
+class Holder:
+    def __init__(self):
+        self._mutex = threading.RLock()
+        self._state = {}
+
+    async def bad_method(self):
+        with self._mutex:  # <- BE-ASYNC-002
+            await asyncio.sleep(0.1)
+            self._state["k"] = 1
+
+
+async def bad_module_lock():
+    with _lock:  # <- BE-ASYNC-002
+        await asyncio.sleep(0.1)
+
+
+# --- negatives -------------------------------------------------------------
+
+
+async def asyncio_lock_is_fine():
+    async with _alock:
+        await asyncio.sleep(0.1)
+
+
+async def lock_without_await_is_fine():
+    with _lock:
+        pass  # held only across sync work: no suspension point
+
+
+def sync_lock_is_fine():
+    with _lock:
+        pass

@@ -1,0 +1,40 @@
+"""Seeded violations for BE-ASYNC-004 (un-awaited coroutine)."""
+
+import asyncio
+
+
+async def flush():
+    await asyncio.sleep(0.1)
+
+
+class Service:
+    async def persist(self):
+        await asyncio.sleep(0.1)
+
+    async def bad_method_call(self):
+        self.persist()  # <- BE-ASYNC-004
+
+    async def good_method_call(self):
+        await self.persist()
+
+
+async def bad_bare_call():
+    flush()  # <- BE-ASYNC-004
+
+
+# --- negatives -------------------------------------------------------------
+
+
+async def awaited_is_fine():
+    await flush()
+
+
+async def tasked_is_fine():
+    t = asyncio.create_task(flush())
+    await t
+
+
+def sync_caller_is_not_checked():
+    # sync context: asyncio.run / runner's responsibility, other linters
+    # (and the runtime warning) cover it
+    asyncio.run(flush())

@@ -1,0 +1,37 @@
+"""A fully clean module: idiomatic async + jitted code, zero findings.
+
+The negative control for tests/test_analysis.py — every rule must stay
+silent here.
+"""
+
+import asyncio
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("downsample",))
+def embed(images, downsample):
+    x = images.reshape(images.shape[0], -1)
+    if downsample > 1:  # static argument: plain python at trace time
+        x = x[:, ::downsample]
+    return x / jnp.linalg.norm(x, axis=-1, keepdims=True)
+
+
+async def serve_embeddings(queue: asyncio.Queue, batcher):
+    lock = asyncio.Lock()
+    while True:
+        batch = await queue.get()
+        async with lock:
+            result = await asyncio.to_thread(batcher, batch)
+        await asyncio.sleep(0)
+        queue.task_done()
+        if result is None:
+            break
+
+
+async def supervised_background(coro_factory):
+    task = asyncio.create_task(coro_factory())
+    task.add_done_callback(lambda t: t.cancelled() or t.exception())
+    return task

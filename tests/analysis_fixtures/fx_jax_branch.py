@@ -1,0 +1,64 @@
+"""Seeded violations for BE-JAX-101 (Python control flow on traced values)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_if(x):
+    if x > 0:  # <- BE-JAX-101
+        return x
+    return -x
+
+
+@jax.jit
+def bad_while(x):
+    while x > 1:  # <- BE-JAX-101
+        x = x / 2
+    return x
+
+
+def call_style(x):
+    if x.sum() > 0:  # <- BE-JAX-101
+        return x
+    return -x
+
+
+call_style_jitted = jax.jit(call_style)
+
+
+# --- negatives -------------------------------------------------------------
+
+
+@jax.jit
+def shape_branch_is_fine(x):
+    if x.shape[0] > 2:  # static metadata, resolved at trace time
+        return x[:2]
+    return x
+
+
+@jax.jit
+def none_check_is_fine(x, mask=None):
+    if mask is None:  # identity check on a python-level default
+        return x
+    return x * mask
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def static_arg_branch_is_fine(x, mode):
+    if mode == "train":  # mode is static: concrete at trace time
+        return x * 2
+    return x
+
+
+def never_jitted(x):
+    if x > 0:  # plain numpy-style function, not traced
+        return x
+    return -x
+
+
+@jax.jit
+def lax_cond_is_fine(x):
+    return jax.lax.cond(jnp.sum(x) > 0, lambda v: v, lambda v: -v, x)

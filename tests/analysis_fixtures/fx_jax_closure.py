@@ -1,0 +1,43 @@
+"""Seeded violations for BE-JAX-104 (closure/global mutation under jit)."""
+
+import jax
+import jax.numpy as jnp
+
+_CACHE = {}
+_TRACE_LOG = []
+_counter = 0
+
+
+@jax.jit
+def bad_append(x):
+    _TRACE_LOG.append("called")  # <- BE-JAX-104
+    return x * 2
+
+
+@jax.jit
+def bad_dict_write(x):
+    _CACHE["last"] = x  # <- BE-JAX-104
+    return x
+
+
+@jax.jit
+def bad_global(x):
+    global _counter  # <- BE-JAX-104
+    _counter += 1
+    return x
+
+
+# --- negatives -------------------------------------------------------------
+
+
+@jax.jit
+def local_mutation_is_fine(x):
+    parts = []
+    parts.append(x)  # local list: trace-time only, but self-contained
+    acc = {}
+    acc["x"] = x
+    return jnp.concatenate(parts), acc["x"]
+
+
+def host_side_cache_is_fine(key, value):
+    _CACHE[key] = value  # never jitted: ordinary host mutation

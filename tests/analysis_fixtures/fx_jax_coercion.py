@@ -1,0 +1,41 @@
+"""Seeded violations for BE-JAX-103 (concretizing coercion under jit)."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_float(x):
+    return float(x)  # <- BE-JAX-103
+
+
+@jax.jit
+def bad_int(x):
+    return int(jnp.sum(x))  # <- BE-JAX-103
+
+
+@jax.jit
+def bad_item(x):
+    return x.item()  # <- BE-JAX-103
+
+
+@jax.jit
+def bad_bool(x):
+    return bool(x)  # <- BE-JAX-103
+
+
+# --- negatives -------------------------------------------------------------
+
+
+@jax.jit
+def astype_is_fine(x):
+    return x.astype(jnp.float32)
+
+
+@jax.jit
+def float_of_shape_is_fine(x):
+    return x * float(x.shape[0])  # static metadata: concrete
+
+
+def host_item_is_fine(arr):
+    return arr.item()  # not jitted: host-side coercion is normal

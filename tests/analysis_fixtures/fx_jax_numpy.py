@@ -1,0 +1,40 @@
+"""Seeded violations for BE-JAX-102 (host numpy on traced values)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_np_abs(x):
+    return np.abs(x)  # <- BE-JAX-102
+
+
+@jax.jit
+def bad_np_keyword(x):
+    return np.sum(x, axis=0)  # <- BE-JAX-102
+
+
+def bad_call_style(batch):
+    return np.mean(batch)  # <- BE-JAX-102
+
+
+bad_call_style_jitted = jax.jit(bad_call_style)
+
+
+# --- negatives -------------------------------------------------------------
+
+
+@jax.jit
+def jnp_is_fine(x):
+    return jnp.abs(x)
+
+
+@jax.jit
+def np_on_static_metadata_is_fine(x):
+    pad = np.zeros(x.shape)  # shapes are concrete at trace time
+    return x + pad
+
+
+def host_side_np_is_fine(batch):
+    return np.mean(batch)  # never jitted: ordinary host numpy

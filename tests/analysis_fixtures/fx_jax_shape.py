@@ -1,0 +1,40 @@
+"""Seeded violations for BE-JAX-105 (traced value as a shape argument)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_zeros(x, n):
+    return x + jnp.zeros(n)  # <- BE-JAX-105
+
+
+@jax.jit
+def bad_reshape(x, n):
+    return x.reshape(n, -1)  # <- BE-JAX-105
+
+
+@jax.jit
+def bad_broadcast(x, n):
+    return jnp.broadcast_to(x, (n, 4))  # <- BE-JAX-105
+
+
+# --- negatives -------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def static_argnums_is_fine(x, n):
+    return x + jnp.zeros(n)  # n is static: concrete python int
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def static_argnames_is_fine(x, n):
+    return x.reshape(n, -1)
+
+
+@jax.jit
+def shape_metadata_is_fine(x):
+    flat = x.reshape(x.shape[0], -1)  # shape tuple is concrete
+    return jnp.zeros(x.shape) + flat.sum()

@@ -1,0 +1,24 @@
+"""Suppression-comment fixture: every seeded violation is ignored.
+
+Exercises all three suppression forms; the analyzer must report zero
+findings for this file.
+"""
+
+import asyncio
+import time
+
+# bioengine: ignore-file[BE-ASYNC-005]
+from pathlib import Path
+
+
+async def same_line_suppression():
+    time.sleep(0.1)  # bioengine: ignore[BE-ASYNC-001]
+
+
+async def line_above_suppression():
+    # bioengine: ignore[BE-ASYNC-003]
+    asyncio.create_task(asyncio.sleep(0.1))
+
+
+async def file_wide_suppression():
+    return Path("status.json").read_text()  # covered by ignore-file above

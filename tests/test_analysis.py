@@ -1,0 +1,287 @@
+"""Static analyzer: rule firing (positive + negative), suppressions,
+baseline behavior, CLI exit codes, and the diff-aware --changed mode.
+
+Fixture files under tests/analysis_fixtures/ seed one violation per
+rule on lines marked ``# <- RULE-ID``; each fixture also carries
+negative cases (idiomatic code the rule must NOT flag).  The harness
+asserts the finding set equals the marker set *exactly*, so a false
+positive on any negative case fails the same assertion as a missed
+detection."""
+
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from bioengine_tpu.analysis import (
+    Baseline,
+    all_rules,
+    analyze_file,
+    analyze_source,
+)
+from bioengine_tpu.analysis.__main__ import main as analysis_main
+from bioengine_tpu.analysis.baseline import TODO_JUSTIFICATION
+
+pytestmark = pytest.mark.unit
+
+FIXTURES = Path(__file__).parent / "analysis_fixtures"
+_MARKER = re.compile(r"#\s*<-\s*(BE-[A-Z]+-\d+)")
+
+
+def expected_markers(path: Path) -> set[tuple[str, int]]:
+    out = set()
+    for lineno, line in enumerate(path.read_text().splitlines(), start=1):
+        for m in _MARKER.finditer(line):
+            out.add((m.group(1), lineno))
+    return out
+
+
+FIXTURE_FILES = sorted(FIXTURES.glob("fx_*.py"))
+assert FIXTURE_FILES, "fixture directory is empty"
+
+
+@pytest.mark.parametrize(
+    "fixture", FIXTURE_FILES, ids=lambda p: p.stem
+)
+def test_fixture_findings_match_markers_exactly(fixture):
+    """Every marked line fires its rule; nothing else fires (the
+    unmarked negative cases in the same file double as the per-rule
+    negative tests)."""
+    found = {(f.rule, f.line) for f in analyze_file(fixture)}
+    assert found == expected_markers(fixture)
+
+
+def test_every_rule_has_a_seeded_fixture_violation():
+    """≥4 rules per pass, each with at least one positive marker."""
+    seeded = set()
+    for f in FIXTURE_FILES:
+        seeded |= {rule for rule, _ in expected_markers(f)}
+    by_pass = {"async": set(), "jax": set()}
+    for r in all_rules():
+        assert r.id in seeded, f"no fixture seeds a violation for {r.id}"
+        by_pass[r.pass_name].add(r.id)
+    assert len(by_pass["async"]) >= 4
+    assert len(by_pass["jax"]) >= 4
+
+
+def test_clean_fixture_is_clean():
+    assert analyze_file(FIXTURES / "fx_clean.py") == []
+
+
+def test_suppression_fixture_is_clean():
+    """Same-line, line-above, and ignore-file forms all suppress."""
+    assert analyze_file(FIXTURES / "fx_suppressed.py") == []
+
+
+def test_suppression_is_rule_specific():
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # bioengine: ignore[BE-ASYNC-999]\n"
+    )
+    # wrong rule id in the ignore -> the finding still fires
+    assert [f.rule for f in analyze_source(src)] == ["BE-ASYNC-001"]
+
+
+def test_blanket_ignore_suppresses_everything():
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # bioengine: ignore\n"
+    )
+    assert analyze_source(src) == []
+
+
+def test_syntax_error_reported_as_finding():
+    findings = analyze_source("def broken(:\n", path="x.py")
+    assert [f.rule for f in findings] == ["BE-PARSE-000"]
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_roundtrip_suppresses_then_goes_stale(tmp_path):
+    fixture = FIXTURES / "fx_async_blocking.py"
+    findings = analyze_file(fixture)
+    assert findings
+
+    bl = Baseline()
+    bl.update_from(findings)
+    assert all(
+        e["justification"] == TODO_JUSTIFICATION for e in bl.entries.values()
+    )
+    new, stale = bl.apply(findings)
+    assert new == [] and stale == []
+
+    # one finding fixed -> its entry is stale, none are blocking
+    new, stale = bl.apply(findings[1:])
+    assert new == [] and len(stale) == 1
+
+    # persisted form survives a round-trip
+    p = tmp_path / "bl.json"
+    bl.save(p)
+    new, stale = Baseline.load(p).apply(findings)
+    assert new == [] and stale == []
+
+
+def test_baseline_fingerprint_tracks_line_content_not_number():
+    src = "import time\nasync def f():\n    time.sleep(1)\n"
+    moved = "import time\n# a new comment shifts lines\nasync def f():\n    time.sleep(1)\n"
+    bl = Baseline()
+    bl.update_from(analyze_source(src, path="m.py"))
+    new, stale = bl.apply(analyze_source(moved, path="m.py"))
+    assert new == [] and stale == []
+
+
+# ---------------------------------------------------------------------------
+# CLI (__main__.main) — exit-code contract
+# ---------------------------------------------------------------------------
+
+
+def test_cli_exits_nonzero_on_seeded_fixtures_without_baseline(capsys):
+    rc = analysis_main([str(FIXTURES), "--no-baseline"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "BE-ASYNC-001" in out and "BE-JAX-101" in out
+
+
+def test_cli_exits_zero_on_clean_file(capsys):
+    rc = analysis_main([str(FIXTURES / "fx_clean.py"), "--no-baseline"])
+    assert rc == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_write_baseline_then_clean(tmp_path, capsys):
+    bl = tmp_path / "bl.json"
+    rc = analysis_main(
+        [str(FIXTURES), "--baseline", str(bl), "--write-baseline"]
+    )
+    assert rc == 0 and bl.exists()
+    rc = analysis_main([str(FIXTURES), "--baseline", str(bl)])
+    assert rc == 0
+    assert "baselined" in capsys.readouterr().out
+
+
+def test_cli_json_format(capsys):
+    rc = analysis_main(
+        [
+            str(FIXTURES / "fx_async_blocking.py"),
+            "--no-baseline",
+            "--format",
+            "json",
+        ]
+    )
+    assert rc == 1
+    findings = json.loads(capsys.readouterr().out)
+    assert {f["rule"] for f in findings} == {"BE-ASYNC-001"}
+
+
+def test_cli_rule_filter(capsys):
+    rc = analysis_main(
+        [str(FIXTURES), "--no-baseline", "--rule", "BE-JAX-105"]
+    )
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "BE-JAX-105" in out and "BE-ASYNC" not in out
+
+
+def test_cli_bad_path_is_usage_error():
+    assert analysis_main(["definitely/not/a/path"]) == 2
+
+
+def test_repo_gate_is_clean():
+    """The merged tree passes its own gate: the checked-in baseline
+    covers every pre-existing finding (acceptance criterion)."""
+    repo = Path(__file__).parent.parent
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "bioengine_tpu.analysis",
+            "bioengine_tpu/",
+            "apps/",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=repo,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_baseline_entries_all_justified():
+    repo = Path(__file__).parent.parent
+    data = json.loads((repo / ".analyze-baseline.json").read_text())
+    for fp, entry in data["findings"].items():
+        assert entry["justification"] != TODO_JUSTIFICATION, (
+            f"baseline entry {fp} ({entry['path']}:{entry['line']}) "
+            f"has no justification"
+        )
+
+
+# ---------------------------------------------------------------------------
+# --changed (diff-aware gate)
+# ---------------------------------------------------------------------------
+
+
+def _git(tmp, *args):
+    subprocess.run(
+        ["git", *args],
+        cwd=tmp,
+        check=True,
+        capture_output=True,
+        env={
+            "GIT_AUTHOR_NAME": "t",
+            "GIT_AUTHOR_EMAIL": "t@t",
+            "GIT_COMMITTER_NAME": "t",
+            "GIT_COMMITTER_EMAIL": "t@t",
+            "PATH": "/usr/bin:/bin:/usr/local/bin",
+            "HOME": str(tmp),
+        },
+    )
+
+
+def test_changed_mode_scans_only_touched_files(tmp_path, monkeypatch):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    dirty = pkg / "dirty.py"
+    clean = pkg / "clean.py"
+    dirty.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    clean.write_text("import time\nasync def g():\n    time.sleep(1)\n")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "seed")
+
+    monkeypatch.chdir(tmp_path)
+
+    # nothing changed since HEAD -> gate passes without scanning pkg/
+    assert analysis_main(["pkg", "--changed", "--no-baseline"]) == 0
+
+    # touch only dirty.py -> its finding fires; clean.py stays unscanned
+    dirty.write_text(
+        "import time\nasync def f():\n    time.sleep(2)\n"
+    )
+    assert analysis_main(["pkg", "--changed", "--no-baseline"]) == 1
+
+    # out-of-scope changes don't trip the gate
+    assert (
+        analysis_main(
+            [str(pkg / "nonexistent_scope"), "--changed", "--no-baseline"]
+        )
+        == 2
+    )
+
+    # from a subdirectory, git's repo-root-relative names must still
+    # resolve (regression: a cwd-relative resolve dropped every file
+    # and reported a false clean)
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    monkeypatch.chdir(sub)
+    assert (
+        analysis_main([str(pkg), "--changed", "--no-baseline"]) == 1
+    )

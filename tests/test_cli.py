@@ -367,3 +367,30 @@ def test_cli_models_list_and_convert(tmp_path):
         params["encoder"]["block0"]["attn"]["qkv"]["kernel"],
         sd["encoder.blocks.0.attn.qkv.weight"].T,
     )
+
+
+# ---- bioengine analyze ------------------------------------------------------
+
+
+def test_cli_analyze_list_rules():
+    result = CliRunner().invoke(cli_main, ["analyze", "--list-rules"])
+    assert result.exit_code == 0
+    assert "BE-ASYNC-001" in result.output
+    assert "BE-JAX-101" in result.output
+
+
+def test_cli_analyze_clean_file_exits_zero():
+    clean = REPO_ROOT / "tests" / "analysis_fixtures" / "fx_clean.py"
+    result = CliRunner().invoke(
+        cli_main, ["analyze", str(clean), "--no-baseline"]
+    )
+    assert result.exit_code == 0, result.output
+
+
+def test_cli_analyze_findings_exit_one():
+    seeded = REPO_ROOT / "tests" / "analysis_fixtures" / "fx_async_blocking.py"
+    result = CliRunner().invoke(
+        cli_main, ["analyze", str(seeded), "--no-baseline"]
+    )
+    assert result.exit_code == 1
+    assert "BE-ASYNC-001" in result.output

@@ -5,6 +5,9 @@ fallback's API parity."""
 import multiprocessing as mp
 import os
 import secrets
+import subprocess
+import sys
+from pathlib import Path
 
 import numpy as np
 import pytest
@@ -264,3 +267,92 @@ class TestAttachSemantics:
         finally:
             b.close()
             a.destroy()
+
+
+# ---------------------------------------------------------------------------
+# Sanitized builds (ASan / TSan) — CI job scripts/workflows/native_sanitizers.sh
+# ---------------------------------------------------------------------------
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+_SAN_BUILD = _REPO_ROOT / "native" / "build"
+
+_SAN_DRIVER = """
+import secrets, threading
+from bioengine_tpu.native import SharedObjectStore, native_available
+
+assert native_available(), "sanitized library failed to load"
+name = f"bes-san-{secrets.token_hex(4)}"
+store = SharedObjectStore(name, capacity=1 << 20, n_slots=512)
+errors = []
+
+def hammer(i):
+    try:
+        for j in range(300):
+            key = f"k{i}-{j}"  # put is put-once: keys must be unique
+            store.put(key, bytes([i + 1]) * (64 + j % 512))
+            val = store.get_bytes(key)  # may be None if LRU-evicted
+            if val is not None and (not val or val[0] != i + 1):
+                errors.append(f"torn read on {key}")
+            if j % 40:  # keep a bounded live set; churn the allocator
+                store.delete(key)
+    except Exception as e:  # noqa: BLE001 - report into the parent assert
+        errors.append(repr(e))
+
+threads = [threading.Thread(target=hammer, args=(i,)) for i in range(8)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+stats = store.stats()
+store.destroy()
+assert not errors, errors[:5]
+print("SAN-DRIVER-OK", stats["put_count"])
+"""
+
+
+def _sanitizer_runtime(san: str) -> str | None:
+    try:
+        out = subprocess.run(
+            ["gcc", f"-print-file-name=lib{san}.so"],
+            capture_output=True, text=True, timeout=30, check=True,
+        ).stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return out if out and os.path.isabs(out) and os.path.exists(out) else None
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("san", ["asan", "tsan"])
+def test_sanitized_store_concurrent_put_get(san):
+    """Concurrent put/get/delete against the ASan/TSan-instrumented
+    store (built by ``make -C native sanitizers``) in a subprocess with
+    the sanitizer runtime preloaded.  Skips when the sanitized .so or
+    the runtime is absent, so plain dev runs stay green; the CI
+    native-sanitizers job builds both and runs this for real."""
+    lib = _SAN_BUILD / f"libbioengine_store_{san}.so"
+    if not lib.exists():
+        pytest.skip(f"{lib.name} not built (make -C native sanitizers)")
+    runtime = _sanitizer_runtime(san)
+    if runtime is None:
+        pytest.skip(f"lib{san}.so runtime not found via gcc")
+
+    env = dict(os.environ)
+    env.update(
+        LD_PRELOAD=runtime,
+        BIOENGINE_STORE_LIB=str(lib),
+        # CPython intentionally leaks at shutdown; we sanitize the
+        # store, not the interpreter
+        ASAN_OPTIONS="detect_leaks=0",
+        TSAN_OPTIONS="exitcode=66",
+        PYTHONPATH=str(_REPO_ROOT),
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", _SAN_DRIVER],
+        capture_output=True, text=True, timeout=300,
+        cwd=_REPO_ROOT, env=env,
+    )
+    report = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"driver failed ({proc.returncode}):\n{report}"
+    assert "SAN-DRIVER-OK" in proc.stdout, report
+    assert "AddressSanitizer" not in report, report
+    assert "ThreadSanitizer" not in report, report
