@@ -74,6 +74,14 @@ class Pipeline:
         if default_blocksize_parameter:
             config.tile = int(default_blocksize_parameter)
             config.max_tile = int(default_blocksize_parameter)
+            # when the default overlap (64 px) meets or exceeds a small
+            # blocksize, the engine clamps overlap to tile-1: stride-1
+            # tiling, every pixel recomputed ~tile^2 times (observed:
+            # 6699 tiles for a 150x140 image at blocksize 64). Only the
+            # degenerate case is rescaled — larger blocksizes keep the
+            # standard 64 px blend ramp unchanged.
+            if config.tile_overlap >= config.tile:
+                config.tile_overlap = max(config.tile // 8, 1)
         self.backend, self.engine = self._build_backend(config)
 
     # ---- weights selection --------------------------------------------------
@@ -214,6 +222,31 @@ class Pipeline:
         y = from_nhwc(y, out_spec.axes)
         return {out_spec.name: y}
 
+    async def predict_async(self, inputs) -> dict[str, np.ndarray]:
+        """Async front door into the engine's overlapped pipeline: the
+        whole prediction (pre/post processing + tiled inference) runs
+        on the engine's single dispatch thread, so concurrent callers
+        never race for one device and the event loop never blocks —
+        without spawning a thread per request via asyncio.to_thread.
+        The torch fallback has no dispatch thread; it keeps to_thread."""
+        if self.backend == "xla":
+            return await asyncio.wrap_future(
+                self.engine.submit(self.predict, inputs)
+            )
+        return await asyncio.to_thread(self.predict, inputs)
+
+    def pipeline_stats(self) -> dict:
+        """Per-stage pipeline accounting (runtime/pipeline.py
+        PipelineStats) — surfaced by Replica.describe and the
+        controller's get_app_status."""
+        stats = getattr(self.engine, "pipeline_stats", None)
+        return stats.as_dict() if stats is not None else {}
+
+    def close(self) -> None:
+        close = getattr(self.engine, "close", None)
+        if callable(close):
+            close()
+
     # ---- self test ----------------------------------------------------------
 
     def run_test(self) -> dict:
@@ -320,7 +353,7 @@ class RuntimeDeployment:
         arrays = [a for _, a in payloads]
         sizes = [len(a) for a in arrays]
         merged = np.concatenate(arrays, axis=0)
-        result = await asyncio.to_thread(pipeline.predict, merged)
+        result = await pipeline.predict_async(merged)
         out_name, y = next(iter(result.items()))
         outs = []
         start = 0
@@ -333,6 +366,30 @@ class RuntimeDeployment:
         if not self._pipelines:
             return  # nothing loaded is a healthy state
         # a wedged XLA client would hang here and fail the health check
+
+    def pipeline_stats(self) -> dict:
+        """Per-pipeline overlapped-pipeline accounting — picked up by
+        Replica.describe (and from there the controller's
+        get_app_status). Keyed on model key PLUS the cache-key prefix:
+        the same model loaded with different weights_format/blocksize
+        is a different pipeline and must not collapse into one entry."""
+        return {
+            f"{p._model_key()}#{key[:8]}": p.pipeline_stats()
+            for key, p in self._pipelines.items()
+            if p.backend == "xla"
+        }
+
+    async def close(self) -> None:
+        """Replica.stop's hook: flush the batcher and release every
+        cached pipeline's engine dispatch thread (LRU eviction only
+        covers pipelines pushed out while running)."""
+        if self._batcher is not None:
+            await self._batcher.close()
+        async with self._lock:
+            pipelines = list(self._pipelines.values())
+            self._pipelines.clear()
+        for p in pipelines:
+            p.close()
 
     # ---- pipeline cache (the reference's multiplexed cache,
     # ref runtime_deployment.py:160-232) ---------------------------------
@@ -365,9 +422,18 @@ class RuntimeDeployment:
             default_blocksize_parameter,
         )
         async with self._lock:
+            existing = self._pipelines.get(key)
+            if existing is not None:
+                # lost a concurrent-build race: keep the first-stored
+                # pipeline (its engine already owns the dispatch thread
+                # and warm programs) and drop our duplicate
+                self._pipelines.move_to_end(key)
+                pipeline.close()
+                return existing
             self._pipelines[key] = pipeline
             while len(self._pipelines) > self.max_pipelines:
-                self._pipelines.popitem(last=False)
+                _, evicted = self._pipelines.popitem(last=False)
+                evicted.close()  # release the engine's dispatch thread
         return pipeline
 
     # ---- handle API (called by the entry deployment) --------------------
@@ -410,7 +476,7 @@ class RuntimeDeployment:
                     signature, (pipeline, array)
                 )
             else:
-                result = await asyncio.to_thread(pipeline.predict, array)
+                result = await pipeline.predict_async(array)
         except Exception as e:
             raise _normalize_oom(e) from e
         ms = (time.time() - t0) * 1000

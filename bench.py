@@ -80,6 +80,7 @@ BASELINE_VIT_IMG_PER_SEC = 500.0  # ref cell-image-search/README.md:122 (1x A100
 STAGE_COSTS = {
     "vit": 60,
     "unet": 45,
+    "pipeline_overlap": 60,
     "cellpose": 60,
     "search": 40,
     "flash": 55,
@@ -228,6 +229,92 @@ def _bench_unet3d(cpu: bool) -> dict:
         "mvoxels_per_sec": round(iters * voxels / best / 1e6, 1),
         "shape": [depth, hw, hw],
     }
+
+
+def _bench_pipeline_overlap(cpu: bool) -> dict:
+    """Serial vs overlapped tiled inference (the engine's blockwise
+    path, runtime/pipeline.py): same model, same tiles, same programs —
+    the delta is purely host/device overlap (async dispatch window +
+    staging/stitch threads + donated buffers). Reports both
+    throughputs, the speedup, the per-stage seconds, and the measured
+    overlap efficiency (device-busy / wall). On CPU the backend
+    dispatch is near-synchronous, so the numbers are informational —
+    the stage exists there to prove the path runs and the artifact
+    schema holds."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bioengine_tpu.models.unet import UNet2D
+    from bioengine_tpu.runtime.engine import EngineConfig, InferenceEngine
+    from bioengine_tpu.runtime.pipeline import PipelineStats
+    from bioengine_tpu.runtime.program_cache import CompiledProgramCache
+
+    if cpu:
+        hw, tile, overlap, feats, items, tile_batch = 192, 64, 8, (4, 8), 1, 4
+    else:
+        hw, tile, overlap, feats, items, tile_batch = (
+            2048, 512, 64, (32, 64, 128, 256), 2, 8,
+        )
+    model = UNet2D(features=feats, out_channels=1)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, tile, tile, 1), jnp.float32)
+    )["params"]
+    cfg = EngineConfig(
+        max_tile=tile, tile=tile, tile_overlap=overlap,
+        tile_batch=tile_batch, pipeline_depth=2,
+    )
+    engine = InferenceEngine(
+        "pipeline-bench",
+        lambda p, x: model.apply({"params": p}, x),
+        params,
+        divisor=model.divisor,
+        config=cfg,
+        cache=CompiledProgramCache(),
+    )
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((items, hw, hw, 1)).astype(np.float32)
+    reps = int(os.environ.get("BENCH_REPS", "2"))
+
+    engine.predict_serial(x)  # warmup: compile every chunk program
+    best_serial = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        engine.predict_serial(x)
+        best_serial = min(best_serial, time.perf_counter() - t0)
+
+    engine.predict(x)  # pipelined warmup (threads, staging buffers)
+    best_pipe = float("inf")
+    stats = None
+    for _ in range(reps):
+        # fresh stats per rep so overlap efficiency reflects the best
+        # rep alone, not warmup or earlier reps
+        engine.pipeline_stats = PipelineStats(depth=cfg.pipeline_depth)
+        t0 = time.perf_counter()
+        engine.predict(x)
+        dt = time.perf_counter() - t0
+        if dt < best_pipe:
+            best_pipe, stats = dt, engine.pipeline_stats
+    try:
+        n_tiles = items * len(
+            engine._tile_plan((hw, hw), engine._axis_specs(4)).coords
+        )
+        stage_detail = stats.as_dict()
+        return {
+            "serial_s": round(best_serial, 3),
+            "pipelined_s": round(best_pipe, 3),
+            "speedup": round(best_serial / max(best_pipe, 1e-9), 3),
+            "serial_tiles_per_sec": round(n_tiles / best_serial, 2),
+            "pipelined_tiles_per_sec": round(n_tiles / best_pipe, 2),
+            "overlap_efficiency": stage_detail["overlap_efficiency"],
+            "pipeline_stats": stage_detail,
+            "image_hw": hw,
+            "tile": tile,
+            "depth": cfg.pipeline_depth,
+            "n_tiles": n_tiles,
+        }
+    finally:
+        engine.close()
 
 
 def _bench_cellpose(cpu: bool) -> dict:
@@ -621,6 +708,7 @@ def worker_main() -> int:
     configs = {
         "vit": _bench_vit,
         "unet": _bench_unet,
+        "pipeline_overlap": _bench_pipeline_overlap,
         "unet3d": _bench_unet3d,
         "cellpose": _bench_cellpose,
         "search": _bench_search,
@@ -747,21 +835,52 @@ def _runner(shared: _Shared, deadline: float) -> None:
     ]
 
     if os.environ.get("BENCH_PLATFORM", "").lower() != "cpu":
-        t0 = time.perf_counter()
-        if not _tunnel_alive():
+        # A wedged tunnel is often transient (backend restart, slow
+        # cold init). Round-5 postmortem: ONE failed 30 s probe
+        # surrendered the whole run with ~450 s still on the clock
+        # (artifact showed attempts: 0). Retry with backoff while the
+        # deadline budget allows a useful attempt; every probe is
+        # recorded in ONE diagnostics entry (diagnostics are truncated
+        # to the last 2 in the artifact, so probes must not crowd out
+        # attempt diagnostics).
+        probes: list[dict] = []
+        probe_diag = {
+            "probe": {"ok": False, "tunnel_wedged": True, "attempts": probes},
+            "note": "jax.devices() hung >30s per fresh-process probe — "
+            "TPU tunnel wedged, no worker attempt made",
+        }
+        backoff = 5.0
+        while True:
+            t0 = time.perf_counter()
+            alive = _tunnel_alive()
+            probes.append(
+                {"ok": alive, "seconds": round(time.perf_counter() - t0, 1)}
+            )
+            if alive:
+                break
+            remaining = deadline - time.monotonic()
+            # a worker attempt needs >=20s budget + margin; below ~90s
+            # another 30s probe + backoff couldn't leave that anyway
+            if remaining < 90.0:
+                with shared.lock:
+                    if probe_diag not in shared.diagnostics:
+                        shared.diagnostics.append(probe_diag)
+                return
             with shared.lock:
-                shared.diagnostics.append(
-                    {
-                        "probe": {
-                            "ok": False,
-                            "tunnel_wedged": True,
-                            "seconds": round(time.perf_counter() - t0, 1),
-                        },
-                        "note": "jax.devices() hung >30s in a fresh "
-                        "process — TPU tunnel wedged, no attempt made",
-                    }
-                )
-            return
+                # record progress NOW so a deadline kill mid-backoff
+                # still shows every probe in the artifact
+                if probe_diag not in shared.diagnostics:
+                    shared.diagnostics.append(probe_diag)
+            time.sleep(min(backoff, max(remaining - 60.0, 1.0)))
+            backoff *= 2
+        if len(probes) > 1:
+            # tunnel recovered after failed probes: keep the record but
+            # mark the outcome
+            probe_diag["probe"]["ok"] = True
+            probe_diag["probe"]["tunnel_wedged"] = False
+            probe_diag["note"] = (
+                f"tunnel recovered after {len(probes) - 1} failed probe(s)"
+            )
 
     for attempt in range(1, attempts + 1):
         with shared.lock:
@@ -865,6 +984,7 @@ def _final_json(shared: _Shared, deadline_hit: bool) -> str:
         extra = {
             "probe": shared.stages.get("probe"),
             "unet256": shared.stages.get("unet"),
+            "pipeline_overlap": shared.stages.get("pipeline_overlap"),
             "unet3d": shared.stages.get("unet3d"),
             "search_latency": shared.stages.get("search"),
             "ivfpq_1m": shared.stages.get("ivfpq"),

@@ -1,5 +1,16 @@
-from bioengine_tpu.runtime.buckets import bucket_shape, pad_to, crop_to
+from bioengine_tpu.runtime.buckets import (
+    bucket_shape,
+    crop_to,
+    fill_bucketed,
+    pad_to,
+)
 from bioengine_tpu.runtime.engine import EngineConfig, InferenceEngine
+from bioengine_tpu.runtime.pipeline import (
+    DispatchExecutor,
+    PipelineStats,
+    StagingPool,
+    run_pipeline,
+)
 from bioengine_tpu.runtime.program_cache import (
     CompiledProgramCache,
     default_program_cache,
@@ -7,10 +18,15 @@ from bioengine_tpu.runtime.program_cache import (
 
 __all__ = [
     "bucket_shape",
+    "fill_bucketed",
     "pad_to",
     "crop_to",
     "EngineConfig",
     "InferenceEngine",
+    "DispatchExecutor",
+    "PipelineStats",
+    "StagingPool",
+    "run_pipeline",
     "CompiledProgramCache",
     "default_program_cache",
 ]

@@ -69,6 +69,21 @@ def pad_to(x: np.ndarray, target_hw: tuple[int, int], axes: tuple[int, int] = (1
     return np.pad(x, pads)
 
 
+def fill_bucketed(dst: np.ndarray, x: np.ndarray) -> None:
+    """In-place counterpart of ``pad_to`` + batch padding: write ``x``
+    into ``dst``'s leading corner and zero everything else. ``dst`` is
+    a reusable staging buffer (runtime/pipeline.py StagingPool), so the
+    steady-state hot path pays one memset + one copy instead of a fresh
+    ``np.pad`` + ``np.concatenate`` allocation pair per call."""
+    if x.ndim != dst.ndim:
+        raise ValueError(f"rank mismatch: {x.shape} into {dst.shape}")
+    for got, have in zip(x.shape, dst.shape):
+        if got > have:
+            raise ValueError(f"{x.shape} exceeds staging buffer {dst.shape}")
+    dst.fill(0)
+    dst[tuple(slice(0, s) for s in x.shape)] = x
+
+
 def crop_to(x: np.ndarray, hw: tuple[int, int], axes: tuple[int, int] = (1, 2)) -> np.ndarray:
     slices = [slice(None)] * x.ndim
     for ax, tgt in zip(axes, hw):

@@ -8,11 +8,24 @@ request -> shape bucket -> compiled-program cache -> padded batch on
 device -> jitted forward -> crop back. Images larger than ``max_tile``
 run tiled with overlap and linear blend stitching (the reference's
 blockwise path, but vectorized: all tiles form one batch).
+
+Tiled prediction runs OVERLAPPED by default (runtime/pipeline.py):
+a staging thread cuts chunk k+1 while the device computes chunk k and
+a stitch thread blends chunk k-1, with a bounded in-flight window
+(``EngineConfig.pipeline_depth``) riding XLA's async dispatch, programs
+compiled with ``donate_argnums`` so each chunk's input HBM buffer is
+recycled into its output, and host chunks assembled in reusable
+per-(bucket, dtype) staging buffers instead of fresh ``pad_to`` +
+``np.concatenate`` copies. ``predict_serial`` keeps the strictly
+serial path as the parity baseline; both produce bit-identical output.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import itertools
+import time
+import warnings
 from typing import Any, Callable, Optional
 
 import jax
@@ -24,7 +37,14 @@ from bioengine_tpu.runtime.buckets import (
     bucket_batch,
     bucket_dim,
     crop_to,
+    fill_bucketed,
     pad_to,
+)
+from bioengine_tpu.runtime.pipeline import (
+    DispatchExecutor,
+    PipelineStats,
+    StagingPool,
+    run_pipeline,
 )
 from bioengine_tpu.runtime.program_cache import (
     CompiledProgramCache,
@@ -47,6 +67,19 @@ class EngineConfig:
     tile_z: int = 32
     tile_overlap_z: int = 8
     ladder_z: tuple = (8, 16, 24, 32, 48, 64, 96, 128)
+    # ---- overlapped pipeline ------------------------------------------------
+    # chunks dispatched to the device but not yet read back; each holds
+    # one (tile_batch, *bucket) HBM buffer, so depth bounds device
+    # memory. 2 = double buffering. 0 disables overlap entirely (the
+    # serial path, one chunk at a time).
+    pipeline_depth: int = 2
+    # staged host chunks cut ahead of dispatch (bounds host RAM)
+    pipeline_prefetch: int = 2
+    # compile with donate_argnums so each chunk's input buffer is
+    # recycled into its output instead of allocating fresh HBM per
+    # chunk. Donation never changes results; XLA falls back silently
+    # when input/output layouts can't alias (e.g. global outputs).
+    donate_buffers: bool = True
 
 
 class InferenceEngine:
@@ -89,18 +122,49 @@ class InferenceEngine:
         self.cache = cache if cache is not None else default_program_cache
         self.device = device or jax.devices()[0]
         self.params = jax.device_put(params, self.device)
+        self.pipeline_stats = PipelineStats(depth=self.config.pipeline_depth)
+        self._staging_pool = StagingPool()
+        self._dispatcher = DispatchExecutor(f"dispatch-{model_id}")
+
+    def close(self) -> None:
+        """Release the async dispatch thread (idempotent)."""
+        self._dispatcher.close()
+
+    def submit(self, fn: Callable, *args: Any, **kwargs: Any):
+        """Run ``fn`` on the engine's dispatch thread; returns a
+        ``concurrent.futures.Future``. The building block behind
+        ``predict_async`` for callers that wrap extra host work
+        (pre/post processing) around the engine — one thread serializes
+        device access instead of a fresh ``to_thread`` per request."""
+        return self._dispatcher.submit(fn, *args, **kwargs)
 
     # ---- program management -------------------------------------------------
 
     def _program(self, shape: tuple[int, ...], dtype) -> Callable:
-        key = (self.model_id, *shape, np.dtype(dtype).name)
+        donate = bool(self.config.donate_buffers)
+        key = (self.model_id, *shape, np.dtype(dtype).name, donate)
 
         def build():
-            fn = jax.jit(self.apply_fn)
+            fn = (
+                jax.jit(self.apply_fn, donate_argnums=(1,))
+                if donate
+                else jax.jit(self.apply_fn)
+            )
             # Trigger compilation now so the first request doesn't pay it
-            # inside the hot path accounting.
-            dummy = jnp.zeros(shape, dtype)
-            fn(self.params, dummy).block_until_ready()
+            # inside the hot path accounting. The dummy must be COMMITTED
+            # to the engine's device — the hot path feeds
+            # device_put(x, self.device) arrays, and an uncommitted
+            # warmup arg compiles a different executable (the hot path
+            # would silently recompile on its first call). Donation is
+            # best-effort: XLA warns when no output can alias the input
+            # (e.g. a global-output model) and runs undonated — not
+            # actionable.
+            dummy = jax.device_put(jnp.zeros(shape, dtype), self.device)
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore", message=".*donated buffers.*"
+                )
+                fn(self.params, dummy).block_until_ready()
             return fn
 
         return self.cache.get_or_compile(key, build)
@@ -130,28 +194,66 @@ class InferenceEngine:
             return [z, xy, xy]
         return [xy, xy]
 
-    def predict(self, images: np.ndarray) -> np.ndarray:
-        """images: (B, H, W, C) or volumes (B, D, H, W, C), host array ->
-        model output, cropped back to the original spatial size. Inputs
-        larger than the per-axis ``max_tile`` run overlap-tiled with
-        linear blend stitching (the reference's blockwise path, ref
-        apps/model-runner/runtime_deployment.py:277-280)."""
+    def _validate(self, images: np.ndarray) -> np.ndarray:
         images = np.asarray(images)
         if images.ndim not in (4, 5):
             raise ValueError(
                 f"expected (B, H, W, C) or (B, D, H, W, C), got {images.shape}"
             )
-        specs = self._axis_specs(images.ndim)
+        return images
+
+    def _needs_tiling(self, images: np.ndarray, specs: list["_AxisSpec"]) -> bool:
         spatial = images.shape[1:-1]
-        if any(size > spec.max_tile for size, spec in zip(spatial, specs)):
+        return any(
+            size > spec.max_tile for size, spec in zip(spatial, specs)
+        )
+
+    def predict(self, images: np.ndarray) -> np.ndarray:
+        """images: (B, H, W, C) or volumes (B, D, H, W, C), host array ->
+        model output, cropped back to the original spatial size. Inputs
+        larger than the per-axis ``max_tile`` run overlap-tiled with
+        linear blend stitching (the reference's blockwise path, ref
+        apps/model-runner/runtime_deployment.py:277-280) through the
+        overlapped pipeline; ``pipeline_depth=0`` falls back to the
+        serial path."""
+        images = self._validate(images)
+        specs = self._axis_specs(images.ndim)
+        if self._needs_tiling(images, specs):
+            if self.config.pipeline_depth > 0:
+                return self._predict_tiled_pipelined(images, specs)
             return np.stack(
                 [self._predict_tiled(item, specs) for item in images]
             )
         return self._predict_direct(images, specs)
 
+    def predict_serial(self, images: np.ndarray) -> np.ndarray:
+        """The strictly serial pre-pipeline path: one chunk cut, put,
+        computed, read back, and stitched at a time, one batch item
+        after another. Kept as the numeric parity baseline for the
+        pipelined path and as the bench's serial leg."""
+        images = self._validate(images)
+        specs = self._axis_specs(images.ndim)
+        if self._needs_tiling(images, specs):
+            return np.stack(
+                [self._predict_tiled(item, specs) for item in images]
+            )
+        return self._predict_direct(images, specs)
+
+    async def predict_async(self, images: np.ndarray) -> np.ndarray:
+        """Async front door: run ``predict`` on the engine's dedicated
+        dispatch thread and await the result. Replicas and the
+        continuous batcher drain into the pipeline through here without
+        wrapping whole predictions in ``asyncio.to_thread`` (no per-call
+        thread, no unbounded concurrent callers racing for one
+        device — the single dispatch thread serializes device access
+        while the pipeline's own staging/stitch threads overlap it)."""
+        import asyncio
+
+        return await asyncio.wrap_future(self.submit(self.predict, images))
+
     def _predict_direct(self, x: np.ndarray, specs: list["_AxisSpec"]) -> np.ndarray:
-        """Bucket every spatial axis, pad, run the compiled program,
-        crop back."""
+        """Bucket every spatial axis, pad into a reusable staging
+        buffer, run the compiled program, crop back."""
         B = x.shape[0]
         C = x.shape[-1]
         spatial = x.shape[1:-1]
@@ -161,13 +263,15 @@ class InferenceEngine:
             for size, spec in zip(spatial, specs)
         )
         bb = bucket_batch(B)
-        x = pad_to(x, buckets, axes=axes)
-        if bb != B:
-            x = np.concatenate(
-                [x, np.zeros((bb - B, *buckets, C), x.dtype)]
+        staged = self._staging_pool.acquire((bb, *buckets, C), x.dtype)
+        try:
+            fill_bucketed(staged, x)
+            program = self._program(staged.shape, staged.dtype)
+            out = np.asarray(
+                program(self.params, jax.device_put(staged, self.device))
             )
-        program = self._program(x.shape, x.dtype)
-        out = np.asarray(program(self.params, jax.device_put(x, self.device)))
+        finally:
+            self._staging_pool.release(staged)
         out = out[:B]
         if out.ndim == len(spatial) + 2:
             out = crop_to(out, spatial, axes=axes)
@@ -180,19 +284,11 @@ class InferenceEngine:
             )
         return out
 
-    def _predict_tiled(
-        self, item: np.ndarray, specs: list["_AxisSpec"]
-    ) -> np.ndarray:
-        """Overlap-tile one (H, W, C) image or (D, H, W, C) stack and
-        stitch with a separable linear ramp (the reference's
-        Gaussian-blend stitching, ref apps/fibsem-mito-analysis/
-        analysis_deployment.py:10-14). Tiles run through the bucketed
-        direct path in chunks of ``tile_batch`` so a large stack never
-        materializes as one giant device batch."""
-        import itertools
+    # ---- tiling geometry (shared by the serial and pipelined paths) ---------
 
-        spatial = item.shape[:-1]
-        # clamp tiles to the item (thin stacks) and overlaps to the tile
+    def _tile_plan(
+        self, spatial: tuple[int, ...], specs: list["_AxisSpec"]
+    ) -> "_TilePlan":
         tsizes = [min(s.tile, max(size, 1)) for s, size in zip(specs, spatial)]
         overlaps = [
             min(s.overlap, max(t - 1, 0)) for s, t in zip(specs, tsizes)
@@ -202,6 +298,24 @@ class InferenceEngine:
             for size, t, o in zip(spatial, tsizes, overlaps)
         ]
         coords = list(itertools.product(*starts_per_axis))
+        buckets = tuple(
+            bucket_dim(t, spec.ladder, spec.divisor)
+            for t, spec in zip(tsizes, specs)
+        )
+        return _TilePlan(tsizes, overlaps, coords, buckets)
+
+    def _predict_tiled(
+        self, item: np.ndarray, specs: list["_AxisSpec"]
+    ) -> np.ndarray:
+        """Overlap-tile one (H, W, C) image or (D, H, W, C) stack and
+        stitch with a separable linear ramp (the reference's
+        Gaussian-blend stitching, ref apps/fibsem-mito-analysis/
+        analysis_deployment.py:10-14). Tiles run through the bucketed
+        direct path in chunks of ``tile_batch`` so a large stack never
+        materializes as one giant device batch."""
+        spatial = item.shape[:-1]
+        plan = self._tile_plan(spatial, specs)
+        tsizes, overlaps, coords = plan.tsizes, plan.overlaps, plan.coords
         spatial_axes = tuple(range(1, len(tsizes) + 1))
 
         def cut(start) -> np.ndarray:
@@ -235,6 +349,122 @@ class InferenceEngine:
                 weight[dst] += ramp[src]
         return acc / np.maximum(weight, 1e-8)
 
+    def _predict_tiled_pipelined(
+        self, images: np.ndarray, specs: list["_AxisSpec"]
+    ) -> np.ndarray:
+        """All batch items' tiles stream through one overlapped
+        pipeline: the staging thread assembles chunk k+1 in a reusable
+        staging buffer while the device computes chunk k (async
+        dispatch, at most ``pipeline_depth`` in flight) and the stitch
+        thread ramp-blends chunk k-1 into the accumulator. Chunk
+        composition is identical to the serial path (per item, tiles in
+        coordinate order, ``tile_batch`` per chunk), so the result is
+        bit-identical to ``predict_serial``."""
+        cfg = self.config
+        B = images.shape[0]
+        C = images.shape[-1]
+        spatial = images.shape[1:-1]
+        plan = self._tile_plan(spatial, specs)
+        tsizes, overlaps, coords, buckets = (
+            plan.tsizes, plan.overlaps, plan.coords, plan.buckets,
+        )
+        chunk = max(int(cfg.tile_batch), 1)
+        ramp = _ramp_nd(tsizes, overlaps)
+
+        # dst/src slices and the blend weight are identical for every
+        # item; computing the weight once (in tile order, matching the
+        # serial accumulation order) keeps results bit-identical
+        dst_src = []
+        weight = np.zeros((*spatial, 1), np.float32)
+        for start in coords:
+            dst = tuple(
+                slice(s0, min(s0 + t, size))
+                for s0, t, size in zip(start, tsizes, spatial)
+            )
+            src = tuple(slice(0, s.stop - s.start) for s in dst)
+            dst_src.append((dst, src))
+            weight[dst] += ramp[src]
+
+        # one desc per (item, tile-chunk) — items feed the same stream,
+        # so the device never drains between batch items
+        descs = [
+            (b, i0, min(i0 + chunk, len(coords)))
+            for b in range(B)
+            for i0 in range(0, len(coords), chunk)
+        ]
+        pool = self._staging_pool
+        stats = self.pipeline_stats
+        state: dict[str, Any] = {"acc": None}
+
+        def fill(desc):
+            b, i0, i1 = desc
+            n = i1 - i0
+            item = images[b]
+            buf = pool.acquire((bucket_batch(n), *buckets, C), images.dtype)
+            tile_region = tuple(slice(0, t) for t in tsizes)
+            for j, start in enumerate(coords[i0:i1]):
+                sl = tuple(
+                    slice(s0, s0 + t) for s0, t in zip(start, tsizes)
+                )
+                buf[(j, *tile_region)] = item[sl]
+                # reused buffers hold stale data: zero the pad margin
+                # between the tile extent and the bucket extent (a
+                # no-op when the tile sits exactly on the ladder)
+                for ax, (t, bkt) in enumerate(zip(tsizes, buckets)):
+                    if bkt > t:
+                        idx = [j, *([slice(None)] * (len(buckets) + 1))]
+                        idx[1 + ax] = slice(t, bkt)
+                        buf[tuple(idx)] = 0
+            buf[n:] = 0  # stale rows from a previous, fuller chunk
+            return buf, n
+
+        def dispatch(desc, staged):
+            buf, n = staged
+            t0 = time.perf_counter()
+            dev = jax.device_put(buf, self.device)
+            t1 = time.perf_counter()
+            program = self._program(buf.shape, buf.dtype)
+            out = program(self.params, dev)
+            stats.add(
+                put_seconds=t1 - t0,
+                dispatch_seconds=time.perf_counter() - t1,
+            )
+            return out, buf, n
+
+        def force(handle):
+            out, buf, n = handle
+            host = np.asarray(out)
+            pool.release(buf)
+            return host[:n]
+
+        def stitch(desc, host):
+            b, i0, i1 = desc
+            if host.ndim != len(spatial) + 2:
+                raise ValueError(
+                    f"tiled prediction requires dense spatial outputs, "
+                    f"model '{self.model_id}' returned {host.shape}"
+                )
+            if state["acc"] is None:
+                state["acc"] = np.zeros(
+                    (B, *spatial, host.shape[-1]), np.float32
+                )
+            acc_b = state["acc"][b]
+            for tile_out, (dst, src) in zip(host, dst_src[i0:i1]):
+                acc_b[dst] += tile_out[src] * ramp[src]
+
+        run_pipeline(
+            descs,
+            fill=fill,
+            dispatch=dispatch,
+            force=force,
+            stitch=stitch,
+            depth=cfg.pipeline_depth,
+            prefetch=cfg.pipeline_prefetch,
+            stats=stats,
+        )
+        stats.add(items=B)
+        return state["acc"] / np.maximum(weight, 1e-8)
+
 
 @dataclasses.dataclass(frozen=True)
 class _AxisSpec:
@@ -245,6 +475,17 @@ class _AxisSpec:
     ladder: tuple
     divisor: int
     max_tile: int
+
+
+@dataclasses.dataclass(frozen=True)
+class _TilePlan:
+    """Shared tiling geometry: clamped tile sizes/overlaps, tile start
+    coordinates (row-major), and the spatial bucket the tiles pad to."""
+
+    tsizes: list[int]
+    overlaps: list[int]
+    coords: list[tuple[int, ...]]
+    buckets: tuple[int, ...]
 
 
 def _tile_starts(size: int, tile: int, overlap: int) -> list[int]:

@@ -24,7 +24,12 @@ class CacheStats:
     hits: int = 0
     misses: int = 0
     evictions: int = 0
+    # per-key compile time for LIVE entries only — evicted programs'
+    # entries are dropped with them (a long-lived replica cycling
+    # through shapes would otherwise grow this dict forever)
     compile_seconds: dict = field(default_factory=dict)
+    # lifetime total, survives evictions
+    cumulative_compile_seconds: float = 0.0
 
     def as_dict(self) -> dict:
         total = self.hits + self.misses
@@ -33,7 +38,8 @@ class CacheStats:
             "misses": self.misses,
             "evictions": self.evictions,
             "hit_rate": self.hits / total if total else 0.0,
-            "total_compile_seconds": sum(self.compile_seconds.values()),
+            "total_compile_seconds": self.cumulative_compile_seconds,
+            "live_compile_seconds": sum(self.compile_seconds.values()),
         }
 
 
@@ -72,10 +78,12 @@ class CompiledProgramCache:
             with self._lock:
                 self.stats.misses += 1
                 self.stats.compile_seconds[str(key)] = dt
+                self.stats.cumulative_compile_seconds += dt
                 self._programs[key] = program
                 self._programs.move_to_end(key)
                 while len(self._programs) > self.max_programs:
-                    self._programs.popitem(last=False)
+                    victim, _ = self._programs.popitem(last=False)
+                    self.stats.compile_seconds.pop(str(victim), None)
                     self.stats.evictions += 1
             return program
         finally:
@@ -96,6 +104,7 @@ class CompiledProgramCache:
             victims = [k for k in self._programs if predicate(k)]
             for k in victims:
                 del self._programs[k]
+                self.stats.compile_seconds.pop(str(k), None)
             self.stats.evictions += len(victims)
             return len(victims)
 

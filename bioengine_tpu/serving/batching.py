@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Hashable, Optional
 
@@ -51,6 +52,9 @@ class ContinuousBatcher:
         self._groups: dict[Hashable, list[_PendingRequest]] = {}
         self._flush_tasks: dict[Hashable, asyncio.Task] = {}
         self._stats = {"requests": 0, "batches": 0, "batched_requests": 0}
+        # queue-wait samples (seconds), recorded per request at group
+        # flush; bounded so stats cost stays flat under load
+        self._wait_samples: deque[float] = deque(maxlen=1024)
         self._closed = False
 
     async def submit(self, signature: Hashable, payload: Any) -> Any:
@@ -91,6 +95,8 @@ class ContinuousBatcher:
             return
         self._stats["batches"] += 1
         self._stats["batched_requests"] += len(group)
+        now = time.monotonic()
+        self._wait_samples.extend(now - r.enqueued_at for r in group)
         try:
             results = await self.batch_fn(
                 signature, [r.payload for r in group]
@@ -120,4 +126,16 @@ class ContinuousBatcher:
         s["avg_batch_size"] = (
             s["batched_requests"] / s["batches"] if s["batches"] else 0.0
         )
+        # how long requests sat in the queue before their group flushed
+        # (from _PendingRequest.enqueued_at) — the latency cost of
+        # batching, observable next to the throughput win
+        waits = sorted(self._wait_samples)
+        if waits:
+            s["queue_wait_ms"] = {
+                "p50": round(1000 * waits[len(waits) // 2], 3),
+                "p95": round(1000 * waits[min(int(len(waits) * 0.95), len(waits) - 1)], 3),
+                "samples": len(waits),
+            }
+        else:
+            s["queue_wait_ms"] = {"p50": 0.0, "p95": 0.0, "samples": 0}
         return s

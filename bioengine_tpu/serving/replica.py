@@ -173,7 +173,7 @@ class Replica:
         return self._ongoing / max(1, self.max_ongoing_requests)
 
     def describe(self) -> dict:
-        return {
+        d = {
             "replica_id": self.replica_id,
             "deployment": self.deployment_name,
             "state": self.state.value,
@@ -184,6 +184,18 @@ class Replica:
             "uptime_seconds": time.time() - self.started_at,
             "last_error": self.last_error,
         }
+        # deployments that run the overlapped inference pipeline expose
+        # a sync ``pipeline_stats()`` (e.g. model-runner's
+        # RuntimeDeployment); surface it so the controller's
+        # get_app_status shows cut/put/compute/readback/stitch seconds
+        # and overlap efficiency per replica
+        stats_fn = getattr(self.instance, "pipeline_stats", None)
+        if callable(stats_fn):
+            try:
+                d["pipeline_stats"] = stats_fn()
+            except Exception as e:  # noqa: BLE001 — stats never break health
+                d["pipeline_stats"] = {"error": str(e)}
+        return d
 
 
 async def _maybe_await(value):

@@ -1,0 +1,206 @@
+"""Generate tests/fixtures_golden_cpsam.npz — an INDEPENDENT forward
+pass of the tiny-config cpsam checkpoint, used as ground truth by
+``tests/test_models.py::TestGoldenCpSAM``.
+
+Why this exists (round-5 ADVICE): the cpsam weight conversion was
+validated only structurally — ``cpsam_name_map`` produces the right
+pytree keys/shapes and spot-checked transposes, but nothing pinned the
+*activations* of the converted model. A transposed-but-wrong kernel,
+a swapped rel-pos table, or an attention-reshape mismatch would pass
+every structural test and silently fine-tune from garbage.
+
+This generator reimplements the public cpsam forward
+(``cellpose.vit_sam.Transformer`` = segment-anything ImageEncoderViT +
+transposed-conv readout) in pure numpy/scipy, straight from the
+TORCH-layout state dict and torch operator semantics:
+
+- Conv2d / ConvTranspose2d are computed from the (O, I, kH, kW) /
+  (I, O, kH, kW) torch kernels directly — no flax-layout transposes
+  shared with ``runtime/convert.py``;
+- attention follows SAM's reference math (qkv reshape/permute,
+  decomposed relative-position bias, window partition) as written in
+  the segment-anything paper repo, not the flax twin's einsum layout;
+- LayerNorm eps = 1e-6 (SAM pins it), exact erf GELU.
+
+The real cellpose/torch packages are deliberately NOT dependencies
+(the TPU image has no egress); this generator is committed so the
+fixture is reproducible: ``python tests/generate_golden_cpsam.py``
+rewrites the npz deterministically. Weights come from
+``synthetic_cpsam_state_dict`` — weights are shared DATA; the forward
+MATH shares no code with ``models/sam.py``.
+
+Fixture contents (tiny config: patch 8, dim 32, depth 2, heads 2,
+window 2, global (1,), neck 16, grid 4):
+  input    (1, 32, 32, 3)  f32 — deterministic N(0,1) image, NHWC
+  encoder  (1, 4, 4, 16)   f32 — neck features (post 2nd LayerNorm)
+  output   (1, 32, 32, 3)  f32 — full cpsam readout
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import numpy as np
+from scipy.special import erf
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from bioengine_tpu.runtime.convert import synthetic_cpsam_state_dict  # noqa: E402
+
+OUT = Path(__file__).parent / "fixtures_golden_cpsam.npz"
+
+CONFIG = dict(
+    patch_size=8, dim=32, depth=2, num_heads=2, window_size=2,
+    global_attn_indexes=(1,), neck_dim=16, pretrain_grid=4,
+)
+EPS = 1e-6  # SAM pins LayerNorm eps=1e-6 everywhere
+
+
+def layer_norm(x: np.ndarray, w: np.ndarray, b: np.ndarray) -> np.ndarray:
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + EPS) * w + b
+
+
+def gelu(x: np.ndarray) -> np.ndarray:
+    return 0.5 * x * (1.0 + erf(x / np.sqrt(2.0)))
+
+
+def softmax(x: np.ndarray) -> np.ndarray:
+    e = np.exp(x - x.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def get_rel_pos(q_size: int, k_size: int, rel_pos: np.ndarray) -> np.ndarray:
+    """SAM's get_rel_pos; the tiny config stores tables at exactly
+    2*max(q,k)-1 so no interpolation branch is needed."""
+    assert rel_pos.shape[0] == 2 * max(q_size, k_size) - 1
+    coords = (
+        np.arange(q_size)[:, None] * max(k_size / q_size, 1.0)
+        - np.arange(k_size)[None, :] * max(q_size / k_size, 1.0)
+        + (k_size - 1) * max(q_size / k_size, 1.0)
+    )
+    return rel_pos[coords.astype(np.int64)]
+
+
+def attention(x: np.ndarray, sd: dict, prefix: str, num_heads: int) -> np.ndarray:
+    """SAM Attention over a (B, H, W, C) token grid, torch semantics."""
+    B, H, W, C = x.shape
+    hd = C // num_heads
+    qkv = x.reshape(B, H * W, C) @ sd[f"{prefix}.qkv.weight"].T
+    qkv = qkv + sd[f"{prefix}.qkv.bias"]
+    qkv = qkv.reshape(B, H * W, 3, num_heads, hd).transpose(2, 0, 3, 1, 4)
+    q, k, v = qkv.reshape(3, B * num_heads, H * W, hd)
+    attn = (q * hd**-0.5) @ k.transpose(0, 2, 1)
+    Rh = get_rel_pos(H, H, sd[f"{prefix}.rel_pos_h"])
+    Rw = get_rel_pos(W, W, sd[f"{prefix}.rel_pos_w"])
+    r_q = q.reshape(B * num_heads, H, W, hd)
+    rel_h = np.einsum("bhwc,hkc->bhwk", r_q, Rh)
+    rel_w = np.einsum("bhwc,wkc->bhwk", r_q, Rw)
+    attn = attn.reshape(B * num_heads, H, W, H, W)
+    attn = attn + rel_h[:, :, :, :, None] + rel_w[:, :, :, None, :]
+    attn = softmax(attn.reshape(B * num_heads, H * W, H * W))
+    out = (attn @ v).reshape(B, num_heads, H * W, hd)
+    out = out.transpose(0, 2, 1, 3).reshape(B, H, W, C)
+    return out @ sd[f"{prefix}.proj.weight"].T + sd[f"{prefix}.proj.bias"]
+
+
+def window_partition(x: np.ndarray, ws: int) -> np.ndarray:
+    B, H, W, C = x.shape
+    assert H % ws == 0 and W % ws == 0  # tiny config: no padding branch
+    x = x.reshape(B, H // ws, ws, W // ws, ws, C)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(-1, ws, ws, C)
+
+
+def window_unpartition(x: np.ndarray, ws: int, H: int, W: int) -> np.ndarray:
+    B = x.shape[0] // ((H // ws) * (W // ws))
+    x = x.reshape(B, H // ws, W // ws, ws, ws, -1)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(B, H, W, -1)
+
+
+def block(x: np.ndarray, sd: dict, i: int, num_heads: int, ws: int) -> np.ndarray:
+    p = f"encoder.blocks.{i}"
+    shortcut = x
+    x = layer_norm(x, sd[f"{p}.norm1.weight"], sd[f"{p}.norm1.bias"])
+    if ws > 0:
+        H, W = x.shape[1:3]
+        win = window_partition(x, ws)
+        win = attention(win, sd, f"{p}.attn", num_heads)
+        x = window_unpartition(win, ws, H, W)
+    else:
+        x = attention(x, sd, f"{p}.attn", num_heads)
+    x = shortcut + x
+    y = layer_norm(x, sd[f"{p}.norm2.weight"], sd[f"{p}.norm2.bias"])
+    y = gelu(y @ sd[f"{p}.mlp.lin1.weight"].T + sd[f"{p}.mlp.lin1.bias"])
+    y = y @ sd[f"{p}.mlp.lin2.weight"].T + sd[f"{p}.mlp.lin2.bias"]
+    return x + y
+
+
+def encoder_forward(img: np.ndarray, sd: dict) -> np.ndarray:
+    cfg = CONFIG
+    p = cfg["patch_size"]
+    B, H, W, _ = img.shape
+    gh, gw = H // p, W // p
+    # torch Conv2d(stride=p, kernel=p): each patch is one matmul row
+    Wp = sd["encoder.patch_embed.proj.weight"]  # (dim, 3, p, p)
+    kern = Wp.transpose(2, 3, 1, 0).reshape(p * p * 3, -1)  # (a,b,c)->dim
+    patches = img.reshape(B, gh, p, gw, p, 3).transpose(0, 1, 3, 2, 4, 5)
+    x = patches.reshape(B, gh, gw, p * p * 3) @ kern
+    x = x + sd["encoder.patch_embed.proj.bias"]
+    x = x + sd["encoder.pos_embed"]  # stored (1, grid, grid, dim); grid == gh
+    for i in range(cfg["depth"]):
+        ws = (
+            0 if i in cfg["global_attn_indexes"] else cfg["window_size"]
+        )
+        x = block(x, sd, i, cfg["num_heads"], ws)
+    # neck: 1x1 conv (no bias), LN, 3x3 SAME conv (no bias), LN —
+    # LayerNorm2d over channels == last-axis LN in this NHWC layout
+    W0 = sd["encoder.neck.0.weight"][:, :, 0, 0]  # (neck, dim)
+    x = x @ W0.T
+    x = layer_norm(x, sd["encoder.neck.1.weight"], sd["encoder.neck.1.bias"])
+    W2 = sd["encoder.neck.2.weight"]  # (neck, neck, 3, 3)
+    xpad = np.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    y = np.zeros_like(x)
+    for a in range(3):
+        for b in range(3):
+            y = y + xpad[:, a : a + gh, b : b + gw, :] @ W2[:, :, a, b].T
+    return layer_norm(
+        y, sd["encoder.neck.3.weight"], sd["encoder.neck.3.bias"]
+    )
+
+
+def readout(feats: np.ndarray, sd: dict) -> np.ndarray:
+    """torch ConvTranspose2d(kernel=stride=p): each input pixel paints
+    one disjoint p x p output block."""
+    p = CONFIG["patch_size"]
+    Wt = sd["out.weight"]  # (in, out=3, p, p)
+    B, gh, gw, _ = feats.shape
+    t = np.tensordot(feats, Wt, axes=([3], [0]))  # (B, gh, gw, 3, p, p)
+    out = t.transpose(0, 1, 4, 2, 5, 3).reshape(B, gh * p, gw * p, 3)
+    return out + sd["out.bias"]
+
+
+def main() -> None:
+    sd = {
+        k: v.astype(np.float64)
+        for k, v in synthetic_cpsam_state_dict(**CONFIG).items()
+    }
+    rng = np.random.default_rng(42)
+    img = rng.standard_normal((1, 32, 32, 3))
+    feats = encoder_forward(img, sd)
+    out = readout(feats, sd)
+    np.savez_compressed(
+        OUT,
+        input=img.astype(np.float32),
+        encoder=feats.astype(np.float32),
+        output=out.astype(np.float32),
+    )
+    print(
+        f"wrote {OUT}: encoder {feats.shape} "
+        f"(|mean|={abs(feats.mean()):.4f}), output {out.shape}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -48,7 +48,10 @@ def _run(env_extra: dict, timeout: float = 240.0):
 
 def test_normal_run_prints_one_parsed_line():
     proc, lines = _run(
-        {"BENCH_CONFIGS": "search", "BENCH_DEADLINE": "180"}
+        {
+            "BENCH_CONFIGS": "search,pipeline_overlap",
+            "BENCH_DEADLINE": "180",
+        }
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert len(lines) == 1, proc.stdout
@@ -56,6 +59,23 @@ def test_normal_run_prints_one_parsed_line():
     assert d["metric"] == "dinov2_vitb14_embed_images_per_sec_per_chip"
     assert d["extra"]["probe"]["ok"]
     assert d["extra"]["search_latency"]["ok"]
+    # the overlapped-pipeline stage must run and emit its schema on CPU
+    # (numbers are informational there; the schema is the contract)
+    po = d["extra"]["pipeline_overlap"]
+    assert po["ok"], po
+    for key in (
+        "serial_s",
+        "pipelined_s",
+        "speedup",
+        "serial_tiles_per_sec",
+        "pipelined_tiles_per_sec",
+        "overlap_efficiency",
+        "pipeline_stats",
+        "depth",
+    ):
+        assert key in po, key
+    assert po["pipeline_stats"]["max_in_flight"] <= po["depth"]
+    assert po["pipeline_stats"]["chunks"] > 0
 
 
 def test_stalled_worker_killed_with_diagnostics_never_rc124():

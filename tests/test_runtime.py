@@ -106,6 +106,24 @@ class TestProgramCache:
         assert cache.evict(lambda k: k[0] == "m1") == 1
         assert cache.keys() == [("m2", 256)]
 
+    def test_eviction_drops_compile_seconds(self):
+        """compile_seconds must not keep entries for evicted programs
+        (a long-lived replica cycling shapes would leak the dict), on
+        BOTH eviction paths; the lifetime total survives."""
+        cache = CompiledProgramCache(max_programs=2)
+        for key in ["a", "b", "c"]:  # "a" evicted by LRU pressure
+            cache.get_or_compile(key, lambda k=key: k)
+        assert set(cache.stats.compile_seconds) == {"b", "c"}
+        cache.evict(lambda k: k == "b")  # predicate path
+        assert set(cache.stats.compile_seconds) == {"c"}
+        d = cache.stats.as_dict()
+        assert d["total_compile_seconds"] >= d["live_compile_seconds"]
+        # lifetime total still counts all three compiles
+        assert (
+            cache.stats.cumulative_compile_seconds
+            > sum(cache.stats.compile_seconds.values()) * 0.99
+        )
+
 
 class TestEngine:
     @pytest.fixture(scope="class")
@@ -483,6 +501,147 @@ class TestFlows:
                 for r in range(1, rec.max() + 1)
             ]
             assert max(ious) > 0.7
+
+
+class TestPipelinedEngine:
+    """The overlapped tiled pipeline (runtime/pipeline.py) against the
+    serial baseline: bit-identical results, a bounded in-flight window,
+    reusable staging buffers, and the async front door."""
+
+    def _engine(self, apply_fn=None, **cfg_overrides):
+        cfg_kw = dict(
+            max_tile=64, tile=48, tile_overlap=16, tile_batch=3,
+            pipeline_depth=2,
+        )
+        cfg_kw.update(cfg_overrides)
+        return InferenceEngine(
+            "pipe",
+            apply_fn or (lambda p, x: x * p["scale"] + 0.25),
+            {"scale": jnp.asarray(1.7)},
+            config=EngineConfig(**cfg_kw),
+            cache=CompiledProgramCache(),
+        )
+
+    def test_planar_identical_to_serial(self):
+        # tile 48 buckets to 64: the staging-buffer pad margins are
+        # exercised, and rtol=0 (exact equality) must still hold
+        eng = self._engine()
+        x = np.random.rand(3, 100, 90, 2).astype(np.float32)
+        serial = eng.predict_serial(x)
+        piped = eng.predict(x)
+        np.testing.assert_allclose(piped, serial, rtol=0, atol=0)
+        np.testing.assert_allclose(piped, x * 1.7 + 0.25, rtol=1e-4, atol=1e-5)
+
+    def test_volumetric_identical_to_serial(self):
+        eng = InferenceEngine(
+            "pipe3d",
+            lambda p, x: x * 3.0,
+            {},
+            config=EngineConfig(
+                max_tile=32, tile=24, tile_overlap=8,
+                max_tile_z=8, tile_z=6, tile_overlap_z=2,
+                ladder_z=(2, 4, 6, 8), tile_batch=2, pipeline_depth=3,
+            ),
+            cache=CompiledProgramCache(),
+        )
+        x = np.random.rand(2, 13, 40, 50, 1).astype(np.float32)
+        serial = eng.predict_serial(x)
+        piped = eng.predict(x)
+        np.testing.assert_allclose(piped, serial, rtol=0, atol=0)
+        assert piped.shape == x.shape
+
+    def test_staging_reuse_after_direct_path_poisoning(self):
+        """A direct (non-tiled) predict shares the staging pool; its
+        stale content in a reused buffer's pad margins must never leak
+        into tiled results (regression: margins between the clamped
+        tile extent and the bucket extent)."""
+        eng = self._engine()
+        x = np.random.rand(2, 100, 90, 2).astype(np.float32)
+        serial = eng.predict_serial(x)
+        # direct predict of a (bb, 64, 64, 2)-bucketed batch writes
+        # nonzero data beyond the 48-wide tile extent
+        eng.predict(np.random.rand(3, 60, 60, 2).astype(np.float32) + 5.0)
+        piped = eng.predict(x)
+        np.testing.assert_allclose(piped, serial, rtol=0, atol=0)
+
+    def test_in_flight_window_bounded(self):
+        for depth in (1, 2, 3):
+            eng = self._engine(pipeline_depth=depth, tile_batch=1)
+            x = np.random.rand(1, 120, 120, 1).astype(np.float32)
+            out = eng.predict(x)
+            stats = eng.pipeline_stats
+            assert stats.chunks >= 4  # enough chunks to fill any window
+            assert stats.max_in_flight <= depth, (depth, stats.as_dict())
+            np.testing.assert_allclose(
+                out, x * 1.7 + 0.25, rtol=1e-4, atol=1e-5
+            )
+
+    def test_depth_zero_disables_pipeline(self):
+        eng = self._engine(pipeline_depth=0)
+        x = np.random.rand(2, 100, 90, 1).astype(np.float32)
+        out = eng.predict(x)
+        np.testing.assert_allclose(
+            out, eng.predict_serial(x), rtol=0, atol=0
+        )
+        assert eng.pipeline_stats.runs == 0  # pipeline never engaged
+
+    def test_staging_buffers_are_recycled(self):
+        eng = self._engine()
+        x = np.random.rand(4, 150, 150, 1).astype(np.float32)
+        for _ in range(3):
+            eng.predict(x)
+        # many chunks over many runs, but the pool only ever allocated
+        # what was concurrently outstanding (depth + prefetch bound)
+        assert eng.pipeline_stats.chunks >= 12
+        cfg = eng.config
+        per_shape_bound = cfg.pipeline_depth + cfg.pipeline_prefetch + 2
+        # two shape keys (full chunks + the smaller trailing chunk)
+        assert eng._staging_pool.allocated <= 2 * per_shape_bound
+
+    def test_stats_accounting(self):
+        eng = self._engine()
+        x = np.random.rand(2, 100, 100, 1).astype(np.float32)
+        eng.predict(x)
+        d = eng.pipeline_stats.as_dict()
+        assert d["runs"] == 1 and d["items"] == 2 and d["chunks"] > 0
+        for stage in ("cut", "put", "dispatch", "readback", "stitch"):
+            assert d[f"{stage}_seconds"] >= 0.0
+        assert d["wall_seconds"] > 0
+        assert 0.0 <= d["overlap_efficiency"] <= 1.5  # clock-skew slack
+
+    def test_error_in_model_propagates_and_pipeline_unwinds(self):
+        def bad_fn(params, x):
+            raise RuntimeError("trace-time boom")
+
+        eng = self._engine(apply_fn=bad_fn)
+        with pytest.raises(RuntimeError, match="boom"):
+            eng.predict(np.random.rand(1, 100, 100, 1).astype(np.float32))
+        # the pipeline must be reusable after an aborted run
+        good = self._engine()
+        good.predict(np.random.rand(1, 100, 100, 1).astype(np.float32))
+
+    def test_global_output_raises_in_pipeline(self):
+        eng = self._engine(apply_fn=lambda p, x: jnp.mean(x, axis=(1, 2)))
+        with pytest.raises(ValueError, match="dense spatial"):
+            eng.predict(np.ones((1, 100, 100, 2), np.float32))
+
+    @pytest.mark.anyio
+    async def test_predict_async_front_door(self):
+        import asyncio
+
+        eng = self._engine()
+        try:
+            x = np.random.rand(2, 100, 90, 1).astype(np.float32)
+            serial = eng.predict_serial(x)
+            # concurrent async callers serialize on the dispatch thread
+            # and all come back correct
+            outs = await asyncio.gather(
+                *(eng.predict_async(x) for _ in range(3))
+            )
+            for out in outs:
+                np.testing.assert_allclose(out, serial, rtol=0, atol=0)
+        finally:
+            eng.close()
 
 
 class TestGlobalOutputGuard:

@@ -273,6 +273,34 @@ class TestBatcher:
         with pytest.raises(RuntimeError, match="results"):
             await asyncio.gather(b.submit("s", 1), b.submit("s", 2))
 
+    async def test_queue_wait_stats_recorded(self):
+        async def batch_fn(sig, payloads):
+            return payloads
+
+        b = ContinuousBatcher(batch_fn, max_batch=100, max_wait_ms=15)
+        await asyncio.gather(*(b.submit("s", i) for i in range(4)))
+        s = b.stats
+        qw = s["queue_wait_ms"]
+        assert qw["samples"] == 4
+        # requests waited for the 15 ms timer flush: p50 must reflect a
+        # real (nonzero) wait, and p95 bounds p50
+        assert qw["p50"] > 0.0
+        assert qw["p95"] >= qw["p50"]
+        # an immediate full-batch flush records near-zero waits
+        b2 = ContinuousBatcher(batch_fn, max_batch=2, max_wait_ms=60_000)
+        await asyncio.gather(b2.submit("s", 1), b2.submit("s", 2))
+        assert b2.stats["queue_wait_ms"]["samples"] == 2
+        assert b2.stats["queue_wait_ms"]["p50"] < 15.0
+
+    async def test_queue_wait_stats_empty(self):
+        async def batch_fn(sig, payloads):
+            return payloads
+
+        b = ContinuousBatcher(batch_fn)
+        assert b.stats["queue_wait_ms"] == {
+            "p50": 0.0, "p95": 0.0, "samples": 0,
+        }
+
     async def test_close_flushes(self):
         async def batch_fn(sig, payloads):
             return payloads
