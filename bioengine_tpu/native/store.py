@@ -92,7 +92,7 @@ def _bind_abi(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.bes_close.argtypes = [ctypes.c_void_p]
     lib.bes_close.restype = None
     lib.bes_put.argtypes = [
-        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64,
     ]
     lib.bes_put.restype = ctypes.c_int
     lib.bes_get_pin.argtypes = [
@@ -187,16 +187,40 @@ class SharedObjectStore:
 
     # ---- core API -----------------------------------------------------------
 
-    def put(self, key: str, data: bytes | bytearray | memoryview) -> None:
-        """Copy ``data`` into the arena (LRU-evicting as needed).
-        Raises FileExistsError if the key is present."""
-        buf = bytes(data) if not isinstance(data, bytes) else data
-        rc = self._lib.bes_put(
-            self._handle, key.encode(), buf, len(buf)
-        )
+    def put(self, key: str, data) -> None:
+        """Copy ``data`` into the arena (LRU-evicting as needed) —
+        exactly ONE copy, the memcpy inside ``bes_put``: bytes,
+        memoryviews, and C-contiguous ndarrays all hand the native
+        layer a raw pointer instead of round-tripping through
+        ``bytes()`` first (the RPC shm fast path's one-copy promise
+        rests on this). Raises FileExistsError if the key is present."""
+        rc = self._put_rc(key, data)
         if rc == -17:  # EEXIST
             raise FileExistsError(key)
         _check(rc, f"put {key!r}")
+
+    def try_put(self, key: str, data) -> bool:
+        """``put`` that reports capacity/key pressure instead of
+        raising: False when the key exists or the store cannot fit the
+        object (full of pinned blocks, or larger than the arena) — the
+        transport's cue to fall back to wire frames."""
+        rc = self._put_rc(key, data)
+        if rc in (-17, -28, -12):  # EEXIST / ENOSPC / ENOMEM
+            return False
+        _check(rc, f"put {key!r}")
+        return True
+
+    def _put_rc(self, key: str, data) -> int:
+        import numpy as np
+
+        # np.frombuffer is the one stdlib-adjacent way to borrow a raw
+        # pointer from read-only bytes/memoryview without copying
+        # (ctypes.from_buffer demands writable memory)
+        flat = np.frombuffer(data, dtype=np.uint8)
+        ptr = ctypes.c_void_p(flat.ctypes.data if flat.size else None)
+        return self._lib.bes_put(
+            self._handle, key.encode(), ptr, flat.size
+        )
 
     def get(self, key: str) -> Optional[memoryview]:
         """Zero-copy view of the stored bytes, or None. The view holds a
@@ -344,6 +368,13 @@ class LocalObjectStore:
             self._order.append(key)
             self._used += len(buf)
             self._stats["put_count"] += 1
+
+    def try_put(self, key: str, data) -> bool:
+        try:
+            self.put(key, data)
+        except (FileExistsError, StoreError):
+            return False
+        return True
 
     def get(self, key: str) -> Optional[memoryview]:
         with self._lock:

@@ -17,6 +17,11 @@ import aiohttp
 
 from bioengine_tpu.rpc import protocol
 from bioengine_tpu.rpc.schema import extract_schema
+from bioengine_tpu.rpc.transport import (
+    Codec,
+    TransportConfig,
+    attach_store_by_name,
+)
 from bioengine_tpu.utils.logger import create_logger
 from bioengine_tpu.utils.tasks import spawn_supervised
 
@@ -46,10 +51,23 @@ class ServiceProxy:
 class ServerConnection:
     """A live WebSocket session with the RPC server."""
 
-    def __init__(self, url: str, token: Optional[str] = None, timeout: float = 300.0):
+    def __init__(
+        self,
+        url: str,
+        token: Optional[str] = None,
+        timeout: float = 300.0,
+        shm_store: Any = "auto",
+        transport_config: Optional[TransportConfig] = None,
+        protocols: Optional[list[str]] = None,
+    ):
         self.url = url
         self.token = token
         self.timeout = timeout
+        # capabilities declared at handshake; [] forces pure-legacy
+        # framing in BOTH directions (bench baseline, interop tests)
+        self.protocols = (
+            [protocol.PROTO_OOB1] if protocols is None else list(protocols)
+        )
         self.client_id: Optional[str] = None
         self.workspace: Optional[str] = None
         self.user_id: Optional[str] = None
@@ -59,22 +77,65 @@ class ServerConnection:
         self._pending: dict[str, asyncio.Future] = {}
         self._local_services: dict[str, dict[str, Callable]] = {}
         self._reader_task: Optional[asyncio.Task] = None
+        self.codec = Codec(config=transport_config or TransportConfig.from_env())
+        self._shm_store_cfg = shm_store
+        self._owns_shm = False
 
     async def connect(self) -> "ServerConnection":
         self._session = aiohttp.ClientSession()
         url = self.url
+        # declare codec support at handshake; a pre-oob server ignores
+        # unknown query params and its welcome carries no "protocols",
+        # so both sides settle on legacy frames automatically
+        if self.protocols:
+            sep = "&" if "?" in url else "?"
+            url = f"{url}{sep}proto={','.join(self.protocols)}"
         if self.token:
             sep = "&" if "?" in url else "?"
             url = f"{url}{sep}token={self.token}"
         self._ws = await self._session.ws_connect(
-            url, max_msg_size=256 * 1024 * 1024
+            url, max_msg_size=self.codec.config.max_msg_size
         )
-        welcome = protocol.decode((await self._ws.receive()).data)
+        welcome = self.codec.decode((await self._ws.receive()).data)
         self.client_id = welcome["client_id"]
         self.workspace = welcome["workspace"]
         self.user_id = welcome["user_id"]
+        self.codec.oob = protocol.PROTO_OOB1 in self.protocols and (
+            protocol.PROTO_OOB1 in welcome.get("protocols", [])
+        )
         self._reader_task = asyncio.create_task(self._read_loop())
+        if self.codec.oob and isinstance(welcome.get("shm"), dict):
+            await self._negotiate_shm(welcome["shm"])
         return self
+
+    async def _negotiate_shm(self, offer: dict) -> None:
+        """Same-host handshake: map the server's segment, read the
+        probe nonce out of it, echo it back. Any failure leaves the
+        connection on wire frames — never fatal."""
+        store = self._shm_store_cfg
+        if store == "auto":
+            store = attach_store_by_name(offer.get("name", ""))
+            self._owns_shm = store is not None
+        if store is None:
+            return
+        try:
+            nonce = store.get_bytes(offer["probe_key"])
+        except Exception:  # noqa: BLE001 — foreign/mismatched segment
+            nonce = None
+        if nonce is None:
+            if self._owns_shm:
+                store.close()
+                self._owns_shm = False
+            return
+        verified = await self._request(
+            {"t": protocol.SHM_ACK, "nonce": nonce}
+        )
+        if verified:
+            self.codec.enable_shm(store)
+            self.logger.info("shm fast path negotiated")
+        elif self._owns_shm:
+            store.close()
+            self._owns_shm = False
 
     async def disconnect(self) -> None:
         if self._reader_task:
@@ -83,6 +144,23 @@ class ServerConnection:
             await self._ws.close()
         if self._session:
             await self._session.close()
+        shm = self.codec.shm_store
+        self.codec.close()
+        if shm is not None and self._owns_shm:
+            shm.close()
+
+    def describe(self) -> dict:
+        """Data-plane counters for this connection (mirrors
+        RpcServer.describe)."""
+        return {
+            "url": self.url,
+            "connected": self.connected,
+            "oob": self.codec.oob,
+            "shm": self.codec.shm_store.name
+            if self.codec.shm_store is not None
+            else None,
+            "transport": self.codec.stats.as_dict(),
+        }
 
     @property
     def connected(self) -> bool:
@@ -96,7 +174,23 @@ class ServerConnection:
             async for msg in self._ws:
                 if msg.type != aiohttp.WSMsgType.BINARY:
                     continue
-                data = protocol.decode(msg.data)
+                try:
+                    data = await self.codec.decode_async(msg.data)
+                except Exception as e:  # noqa: BLE001
+                    # a poisoned message (e.g. its shm object was
+                    # evicted before we consumed it) must cost only
+                    # that message — the affected call times out, the
+                    # connection and every other in-flight call live
+                    self.logger.error(f"dropping undecodable message: {e}")
+                    continue
+                finally:
+                    # retry releasing pins of earlier shm payloads
+                    # whose consumers have since dropped their views
+                    # (results are handed to caller futures, so the
+                    # release point is only observable opportunistically)
+                    self.codec.drain_pins()
+                if data is None:
+                    continue  # mid-reassembly chunk
                 t = data.get("t")
                 if t in (protocol.RESULT, protocol.ERROR):
                     fut = self._pending.pop(data.get("call_id", ""), None)
@@ -121,13 +215,17 @@ class ServerConnection:
         except asyncio.CancelledError:
             pass
 
-    async def _request(self, msg: dict) -> Any:
+    async def _send_msg(self, msg: dict) -> None:
         assert self._ws is not None, "not connected"
+        for frame in await self.codec.encode_frames_async(msg):
+            await self._ws.send_bytes(frame)
+
+    async def _request(self, msg: dict) -> Any:
         call_id = uuid.uuid4().hex
         msg["call_id"] = call_id
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[call_id] = fut
-        await self._ws.send_bytes(protocol.encode(msg))
+        await self._send_msg(msg)
         return await asyncio.wait_for(fut, self.timeout)
 
     async def _handle_incoming_call(self, msg: dict) -> None:
@@ -140,25 +238,25 @@ class ServerConnection:
             result = fn(*msg.get("args", []), **msg.get("kwargs", {}))
             if asyncio.iscoroutine(result):
                 result = await result
-            await self._ws.send_bytes(
-                protocol.encode(
-                    {
-                        "t": protocol.RESULT,
-                        "call_id": msg.get("call_id"),
-                        "result": result,
-                    }
-                )
+            await self._send_msg(
+                {
+                    "t": protocol.RESULT,
+                    "call_id": msg.get("call_id"),
+                    "result": result,
+                }
             )
         except Exception as e:
-            await self._ws.send_bytes(
-                protocol.encode(
-                    {
-                        "t": protocol.ERROR,
-                        "call_id": msg.get("call_id"),
-                        "error": e,
-                    }
-                )
+            await self._send_msg(
+                {
+                    "t": protocol.ERROR,
+                    "call_id": msg.get("call_id"),
+                    "error": e,
+                }
             )
+        finally:
+            # args decoded from shm refs die with the handler — let the
+            # store reclaim their blocks
+            self.codec.drain_pins()
 
     # ---- public API (hypha-shaped) ------------------------------------------
 
@@ -219,21 +317,29 @@ class ServerConnection:
         )
 
     async def ping(self) -> float:
-        assert self._ws is not None
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending["__ping__"] = fut
-        await self._ws.send_bytes(protocol.encode({"t": protocol.PING}))
+        await self._send_msg({"t": protocol.PING})
         return await asyncio.wait_for(fut, 10.0)
 
 
 async def connect_to_server(config: dict[str, Any]) -> ServerConnection:
-    """hypha-style entry point: ``{"server_url": ..., "token": ...}``."""
+    """hypha-style entry point: ``{"server_url": ..., "token": ...}``.
+
+    Optional transport keys: ``shm_store`` (a store instance for the
+    same-host fast path, ``"auto"`` to attach the advertised native
+    segment, None to disable) and ``transport_config``."""
     url = config["server_url"]
     if url.startswith("http"):
         url = "ws" + url[4:]
     if not url.endswith("/ws"):
         url = url.rstrip("/") + "/ws"
     conn = ServerConnection(
-        url, token=config.get("token"), timeout=config.get("method_timeout", 300.0)
+        url,
+        token=config.get("token"),
+        timeout=config.get("method_timeout", 300.0),
+        shm_store=config.get("shm_store", "auto"),
+        transport_config=config.get("transport_config"),
+        protocols=config.get("protocols"),
     )
     return await conn.connect()

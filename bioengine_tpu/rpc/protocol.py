@@ -6,15 +6,47 @@ framework ships its own control plane with the same *shape* —
 service registration, method calls with injected caller context,
 token auth — so deployments need no external RPC broker.
 
-Messages are msgpack maps with a ``t`` (type) field. Payloads pass
-through ``encode``/``decode`` which handle numpy arrays (zero-copy
-raw-bytes + dtype/shape envelope), bytes, and Exception values.
+Two codecs share this module:
+
+**Legacy** (``encode``/``decode``): one msgpack map; ndarrays ride as
+ExtType(1) carrying ``dtype/shape/data`` packed *again* inside the
+outer message. Every array crossing the plane is copied at least three
+times per direction (``tobytes`` -> inner pack -> outer pack, then the
+mirror on decode). Kept verbatim for interop with peers that predate
+out-of-band framing.
+
+**Out-of-band** (``encode_oob``/``decode_oob``): one scatter-gather
+frame. A pre-walk extracts every large ndarray/bytes payload into a
+buffer table and replaces it with a tiny ExtType(3) ref
+(``{"i": idx, "d": dtype, "s": shape}``); the remaining small header
+packs once, and raw buffers are appended 64-byte-aligned after it —
+each payload is memcpy'd exactly once into the frame. ``decode_oob``
+rebuilds arrays with ``np.frombuffer`` directly over the received
+frame's memoryview: **zero** payload copies on receive. ExtType(4)
+refs point into the host-shared shm object store instead (key, not
+bytes): the receive side maps those zero-copy too, so a same-host hop
+costs one copy total (the store put). Transport-level concerns —
+chunked multi-frame sends, shm negotiation, stats — live in
+``rpc/transport.py``.
+
+Frame layout (all integers little-endian)::
+
+    b"BEF1" | u32 meta_len | meta | pad to 64 | buf0 | pad | buf1 | ...
+    meta = msgpack {"h": <packed message with ExtType refs>,
+                    "b": [[rel_offset, length], ...]}
+
+``rel_offset`` is relative to ``payload_start =
+align64(8 + meta_len)`` so every buffer lands 64-byte-aligned in the
+assembled frame (aligned ``np.frombuffer`` views are vectorization-
+friendly). The magic byte 0x42 can never open a legacy message (a
+msgpack map starts 0x80-0x8f or 0xde/0xdf), so ``is_oob_frame``
+dispatch is unambiguous.
 """
 
 from __future__ import annotations
 
 import traceback
-from typing import Any
+from typing import Any, Callable, Optional
 
 import msgpack
 import numpy as np
@@ -29,37 +61,60 @@ TOKEN = "token"                # generate_token request
 LIST = "list_services"
 PING = "ping"
 PONG = "pong"
+SHM_ACK = "shm_ack"            # client proves it mapped the shared store
+
+# wire identifiers
+OOB_MAGIC = b"BEF1"            # out-of-band scatter-gather frame
+CHUNK_MAGIC = b"BEC1"          # one chunk of an oversized frame
+PROTO_OOB1 = "oob1"            # negotiated capability name
+
+EXT_NDARRAY = 1                # legacy inline array (double-packed)
+EXT_EXCEPTION = 2
+EXT_OOB_REF = 3                # ref into this frame's buffer table
+EXT_SHM_REF = 4                # ref into the host-shared object store
+
+# payloads below this stay inline as legacy ExtType(1) — the envelope
+# overhead of a table entry isn't worth it for scalars and tiny arrays
+INLINE_LIMIT = 1024
+
+
+def _pack_exception(obj: Exception) -> msgpack.ExtType:
+    return msgpack.ExtType(
+        EXT_EXCEPTION,
+        msgpack.packb(
+            {
+                "type": type(obj).__name__,
+                "message": str(obj),
+                "traceback": "".join(
+                    traceback.format_exception(obj)
+                )[-4000:],
+            }
+        ),
+    )
+
+
+def _pack_inline_ndarray(obj: np.ndarray) -> msgpack.ExtType:
+    return msgpack.ExtType(
+        EXT_NDARRAY,
+        msgpack.packb(
+            {
+                "dtype": obj.dtype.str,
+                "shape": list(obj.shape),
+                "data": obj.tobytes(),
+            }
+        ),
+    )
 
 
 def _default(obj: Any) -> Any:
     if isinstance(obj, np.ndarray):
-        return msgpack.ExtType(
-            1,
-            msgpack.packb(
-                {
-                    "dtype": obj.dtype.str,
-                    "shape": list(obj.shape),
-                    "data": obj.tobytes(),
-                }
-            ),
-        )
+        return _pack_inline_ndarray(obj)
     if isinstance(obj, (np.integer,)):
         return int(obj)
     if isinstance(obj, (np.floating,)):
         return float(obj)
     if isinstance(obj, Exception):
-        return msgpack.ExtType(
-            2,
-            msgpack.packb(
-                {
-                    "type": type(obj).__name__,
-                    "message": str(obj),
-                    "traceback": "".join(
-                        traceback.format_exception(obj)
-                    )[-4000:],
-                }
-            ),
-        )
+        return _pack_exception(obj)
     raise TypeError(f"Cannot serialize {type(obj)}")
 
 
@@ -73,20 +128,176 @@ class RemoteError(RuntimeError):
 
 
 def _ext_hook(code: int, data: bytes) -> Any:
-    if code == 1:
+    if code == EXT_NDARRAY:
         d = msgpack.unpackb(data)
         return np.frombuffer(d["data"], dtype=np.dtype(d["dtype"])).reshape(
             d["shape"]
         )
-    if code == 2:
+    if code == EXT_EXCEPTION:
         d = msgpack.unpackb(data)
         return RemoteError(d["type"], d["message"], d.get("traceback", ""))
     return msgpack.ExtType(code, data)
 
 
 def encode(msg: dict) -> bytes:
+    """Legacy single-blob encoding (interop baseline)."""
     return msgpack.packb(msg, default=_default, use_bin_type=True)
 
 
-def decode(data: bytes) -> dict:
-    return msgpack.unpackb(data, ext_hook=_ext_hook, raw=False)
+def decode(data) -> dict:
+    """Legacy single-blob decoding. Shm refs cannot appear here (they
+    require a negotiated store); an ExtType(4) raises loudly rather
+    than returning a silent placeholder."""
+    return msgpack.unpackb(bytes(data), ext_hook=_ext_hook, raw=False)
+
+
+# ---------------------------------------------------------------------------
+# Out-of-band codec
+# ---------------------------------------------------------------------------
+
+
+def is_oob_frame(data) -> bool:
+    return bytes(data[:4]) == OOB_MAGIC
+
+
+def is_chunk_frame(data) -> bool:
+    return bytes(data[:4]) == CHUNK_MAGIC
+
+
+def _align64(n: int) -> int:
+    return (n + 63) & ~63
+
+
+def payload_nbytes(obj: Any, _depth: int = 0) -> int:
+    """Recursive estimate of the raw tensor/bytes payload a message
+    carries — what decides off-loop encode and chunking, computed
+    without serializing anything."""
+    if _depth > 8:
+        return 0
+    if isinstance(obj, np.ndarray):
+        return obj.nbytes
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return len(obj)
+    if isinstance(obj, dict):
+        return sum(payload_nbytes(v, _depth + 1) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return sum(payload_nbytes(v, _depth + 1) for v in obj)
+    return 0
+
+
+def _extract(obj: Any, buffers: list, shm_put: Optional[Callable]) -> Any:
+    """Pre-walk replacing large payloads with ExtType refs.
+
+    ``buffers`` collects flat C-order memoryviews (the scatter list);
+    ``shm_put(buf) -> key | None`` diverts a buffer into the shared
+    store instead (None = store full/absent, fall back to the wire)."""
+    if isinstance(obj, np.ndarray):
+        if obj.nbytes < INLINE_LIMIT:
+            return _pack_inline_ndarray(obj)
+        arr = np.ascontiguousarray(obj)  # copies only if non-contiguous
+        desc = {"d": arr.dtype.str, "s": list(arr.shape)}
+        return _ref_for(memoryview(arr).cast("B"), desc, buffers, shm_put)
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        buf = memoryview(obj).cast("B") if not isinstance(obj, bytes) else obj
+        if len(buf) < INLINE_LIMIT:
+            return bytes(buf) if not isinstance(obj, bytes) else obj
+        return _ref_for(
+            buf if isinstance(buf, memoryview) else memoryview(buf),
+            {"y": 1},
+            buffers,
+            shm_put,
+        )
+    if isinstance(obj, dict):
+        return {k: _extract(v, buffers, shm_put) for k, v in obj.items()}
+    if isinstance(obj, msgpack.ExtType):
+        # ExtType is a namedtuple — the tuple branch below would
+        # flatten it into [code, data]; pass it through to msgpack
+        return obj
+    if isinstance(obj, (list, tuple)):
+        return [_extract(v, buffers, shm_put) for v in obj]
+    return obj
+
+
+def _ref_for(
+    buf: memoryview, desc: dict, buffers: list, shm_put: Optional[Callable]
+) -> msgpack.ExtType:
+    if shm_put is not None:
+        key = shm_put(buf)
+        if key is not None:
+            return msgpack.ExtType(
+                EXT_SHM_REF,
+                msgpack.packb({**desc, "k": key, "n": buf.nbytes}),
+            )
+    idx = len(buffers)
+    buffers.append(buf)
+    return msgpack.ExtType(EXT_OOB_REF, msgpack.packb({**desc, "i": idx}))
+
+
+def encode_oob(msg: dict, shm_put: Optional[Callable] = None) -> bytearray:
+    """Encode ``msg`` as one scatter-gather frame.
+
+    Each extracted payload buffer is written into the frame exactly
+    once (or diverted to the shared store via ``shm_put``); everything
+    else packs into the small header. Returns the assembled frame —
+    ``bytearray`` so callers can send slices without another copy."""
+    buffers: list[memoryview] = []
+    header = msgpack.packb(
+        _extract(msg, buffers, shm_put), default=_default, use_bin_type=True
+    )
+    table = []
+    rel = 0
+    for buf in buffers:
+        rel = _align64(rel)
+        table.append([rel, buf.nbytes])
+        rel += buf.nbytes
+    meta = msgpack.packb({"h": header, "b": table})
+    payload_start = _align64(8 + len(meta))
+    frame = bytearray(payload_start + rel)
+    frame[0:4] = OOB_MAGIC
+    frame[4:8] = len(meta).to_bytes(4, "little")
+    frame[8 : 8 + len(meta)] = meta
+    for (off, length), buf in zip(table, buffers):
+        frame[payload_start + off : payload_start + off + length] = buf
+    return frame
+
+
+def decode_oob(data, shm_get: Optional[Callable] = None) -> dict:
+    """Decode a scatter-gather frame.
+
+    Arrays referenced through the buffer table come back as
+    ``np.frombuffer`` views **over the received frame** — zero copies,
+    read-only (mutate via ``.copy()`` when needed, same contract the
+    legacy decoder already had). ``shm_get(descriptor) -> value``
+    materializes store-resident payloads (array view over the shm
+    segment, or bytes) and owns their pin lifetime
+    (rpc.transport.ShmPinTracker)."""
+    mv = memoryview(data)
+    if bytes(mv[:4]) != OOB_MAGIC:
+        raise ValueError("not an out-of-band frame")
+    meta_len = int.from_bytes(mv[4:8], "little")
+    meta = msgpack.unpackb(mv[8 : 8 + meta_len], raw=False)
+    table = meta["b"]
+    payload = mv[_align64(8 + meta_len) :]
+
+    def hook(code: int, ext_data: bytes) -> Any:
+        if code == EXT_OOB_REF:
+            d = msgpack.unpackb(ext_data)
+            off, length = table[d["i"]]
+            raw = payload[off : off + length]
+            if d.get("y"):
+                return bytes(raw)
+            return np.frombuffer(raw, dtype=np.dtype(d["d"])).reshape(d["s"])
+        if code == EXT_SHM_REF:
+            d = msgpack.unpackb(ext_data)
+            if shm_get is None:
+                raise RuntimeError(
+                    "message references the shared object store but this "
+                    "peer has none attached (negotiation bug)"
+                )
+            # shm_get materializes the value itself (array view over
+            # the segment, or bytes) because pin lifetime must be tied
+            # to the object it hands out — see transport.ShmPinTracker
+            return shm_get(d)
+        return _ext_hook(code, ext_data)
+
+    return msgpack.unpackb(meta["h"], ext_hook=hook, raw=False)

@@ -30,6 +30,7 @@ from aiohttp import WSMsgType, web
 
 from bioengine_tpu.rpc import protocol
 from bioengine_tpu.rpc.schema import extract_schema
+from bioengine_tpu.rpc.transport import Codec, RpcStats, TransportConfig
 from bioengine_tpu.utils.logger import create_logger
 from bioengine_tpu.utils.tasks import spawn_supervised
 
@@ -95,6 +96,8 @@ class RpcServer:
         admin_users: Optional[list[str]] = None,
         default_workspace: str = "bioengine",
         token_ttl_seconds: float = 3600 * 24,
+        shm_store: Any = "auto",
+        transport_config: Optional[TransportConfig] = None,
     ):
         self.host = host
         self.port = port
@@ -114,10 +117,49 @@ class RpcServer:
         self._static_dirs: dict[str, Any] = {}  # name -> Path
         self.artifact_service = None            # attach_artifact_service
         self._mcp_apps: dict[str, Any] = {}     # app_id -> AppServiceProxy
+        # zero-copy data plane: one Codec per websocket client, all
+        # feeding one server-wide RpcStats (surfaced by describe())
+        self.transport_config = transport_config or TransportConfig.from_env()
+        self.stats = RpcStats()
+        self._client_codecs: dict[str, Codec] = {}
+        self._shm_store_cfg = shm_store
+        self._shm_store: Any = None
+        self._shm_nonces: dict[str, tuple[str, bytes]] = {}  # client -> (key, nonce)
 
     # ---- lifecycle ----------------------------------------------------------
 
+    def _resolve_shm_store(self) -> Any:
+        """The same-host fast-path segment. ``"auto"`` attaches (or
+        creates) the shared native segment when the toolchain allows;
+        an explicit store instance is used as-is (how tests wire a
+        LocalObjectStore through both ends in-process); None disables.
+        Auto failures are silent by design — the wire path is always
+        sufficient."""
+        cfg = self._shm_store_cfg
+        if cfg is None:
+            return None
+        if cfg != "auto":
+            return cfg
+        import os as _os
+
+        if _os.environ.get("BIOENGINE_RPC_SHM", "1") == "0":
+            return None
+        from bioengine_tpu.native import store as native_store
+
+        if not native_store.native_available():
+            return None
+        try:
+            name = _os.environ.get("BIOENGINE_RPC_STORE_NAME", "bioengine-rpc")
+            cap_mb = float(_os.environ.get("BIOENGINE_RPC_STORE_MB", "256"))
+            return native_store.SharedObjectStore(
+                name, capacity=int(cap_mb * 1024 * 1024), create="attach"
+            )
+        except Exception as e:  # noqa: BLE001 — degrade to wire frames
+            self.logger.warning(f"shm store unavailable ({e}); wire-only")
+            return None
+
     async def start(self) -> str:
+        self._shm_store = self._resolve_shm_store()
         app = web.Application(client_max_size=256 * 1024 * 1024)
         app.router.add_get("/ws", self._handle_ws)
         app.router.add_get("/health/liveness", self._handle_health)
@@ -149,6 +191,38 @@ class RpcServer:
             await ws.close()
         if self._runner:
             await self._runner.cleanup()
+        for codec in self._client_codecs.values():
+            codec.close()
+        self._client_codecs.clear()
+        if self._shm_store is not None:
+            self._shm_store.close()  # segment stays for other processes
+            self._shm_store = None
+
+    def describe(self) -> dict:
+        """Control-plane + data-plane observability: who's connected,
+        what's registered, and the transport counters (bytes, frames,
+        chunked sends, encode/decode seconds, shm hit-rate)."""
+        d = {
+            "url": self.url,
+            "services": len(self._services),
+            "clients": len(self._clients),
+            "transport": self.stats.as_dict(),
+            "shm": None,
+        }
+        if self._shm_store is not None:
+            shm_clients = sum(
+                1 for c in self._client_codecs.values() if c.shm_store is not None
+            )
+            try:
+                store_stats = self._shm_store.stats()
+            except Exception as e:  # noqa: BLE001 — stats never break status
+                store_stats = {"error": str(e)}
+            d["shm"] = {
+                "store": self._shm_store.name,
+                "negotiated_clients": shm_clients,
+                **store_stats,
+            }
+        return d
 
     @property
     def url(self) -> str:
@@ -302,17 +376,17 @@ class RpcServer:
         self._pending[call_id] = fut
         self._pending_owner[call_id] = entry.owner_client
         try:
-            await ws.send_bytes(
-                protocol.encode(
-                    {
-                        "t": protocol.CALL,
-                        "call_id": call_id,
-                        "service_id": full_id,
-                        "method": method,
-                        "args": list(args),
-                        "kwargs": kwargs,
-                    }
-                )
+            await self._send(
+                ws,
+                self._client_codecs.get(entry.owner_client),
+                {
+                    "t": protocol.CALL,
+                    "call_id": call_id,
+                    "service_id": full_id,
+                    "method": method,
+                    "args": list(args),
+                    "kwargs": kwargs,
+                },
             )
             return await asyncio.wait_for(fut, timeout)
         finally:
@@ -473,6 +547,17 @@ class RpcServer:
                 {"error": f"{type(e).__name__}: {e}"}, status=500
             )
 
+    async def _send(
+        self, ws: web.WebSocketResponse, codec: Optional[Codec], msg: dict
+    ) -> None:
+        """Encode per the client's negotiated capabilities and send —
+        one websocket message per frame (oversized frames go out as a
+        chunk sequence). Large payloads encode off-loop."""
+        if codec is None:
+            codec = Codec(config=self.transport_config, stats=self.stats)
+        for frame in await codec.encode_frames_async(msg):
+            await ws.send_bytes(frame)
+
     async def _handle_ws(self, request: web.Request) -> web.WebSocketResponse:
         token = request.query.get("token", "")
         try:
@@ -485,29 +570,54 @@ class RpcServer:
         except PermissionError as e:
             raise web.HTTPUnauthorized(reason=str(e))
 
-        ws = web.WebSocketResponse(max_msg_size=256 * 1024 * 1024)
+        ws = web.WebSocketResponse(max_msg_size=self.transport_config.max_msg_size)
         await ws.prepare(request)
         client_id = uuid.uuid4().hex
+        codec = Codec(config=self.transport_config, stats=self.stats)
+        # the client declares codec support at handshake time; anything
+        # it doesn't declare gets legacy single-blob frames forever
+        codec.oob = protocol.PROTO_OOB1 in request.query.get("proto", "").split(",")
         self._clients[client_id] = ws
         self._client_users[client_id] = info
-        await ws.send_bytes(
-            protocol.encode(
-                {
-                    "t": "welcome",
-                    "client_id": client_id,
-                    "workspace": info.workspace,
-                    "user_id": info.user_id,
-                }
-            )
-        )
+        self._client_codecs[client_id] = codec
+        welcome = {
+            "t": "welcome",
+            "client_id": client_id,
+            "workspace": info.workspace,
+            "user_id": info.user_id,
+            "protocols": [protocol.PROTO_OOB1],
+        }
+        if codec.oob and self._shm_store is not None:
+            # same-host probe: the client must read this nonce OUT OF
+            # the segment and echo it back — proof the two processes
+            # map the same shm, not just claim the same store name
+            probe_key = f"rpc/probe/{client_id}"
+            nonce = secrets.token_bytes(16)
+            try:
+                if self._shm_store.try_put(probe_key, nonce):
+                    self._shm_nonces[client_id] = (probe_key, nonce)
+                    welcome["shm"] = {
+                        "name": self._shm_store.name,
+                        "probe_key": probe_key,
+                    }
+            except Exception as e:  # noqa: BLE001 — probe failure = wire-only
+                self.logger.warning(f"shm probe put failed: {e}")
+        await self._send(ws, codec, welcome)
         try:
             async for msg in ws:
                 if msg.type != WSMsgType.BINARY:
                     continue
                 try:
-                    await self._dispatch(client_id, ws, protocol.decode(msg.data))
+                    decoded = await codec.decode_async(msg.data)
+                    if decoded is None:
+                        continue  # mid-reassembly chunk
+                    await self._dispatch(client_id, ws, decoded)
                 except Exception as e:  # keep the connection alive
                     self.logger.error(f"dispatch error: {e}")
+                finally:
+                    # one-shot shm payloads whose consumers finished
+                    # leave the arena as soon as possible
+                    codec.drain_pins()
         finally:
             self._drop_client(client_id)
         return ws
@@ -515,6 +625,15 @@ class RpcServer:
     def _drop_client(self, client_id: str) -> None:
         self._clients.pop(client_id, None)
         self._client_users.pop(client_id, None)
+        codec = self._client_codecs.pop(client_id, None)
+        if codec is not None:
+            codec.close()
+        probe = self._shm_nonces.pop(client_id, None)
+        if probe is not None and self._shm_store is not None:
+            try:
+                self._shm_store.delete(probe[0])
+            except Exception:  # noqa: BLE001 — client may have deleted it
+                pass
         for full_id in [
             fid
             for fid, e in self._services.items()
@@ -542,9 +661,38 @@ class RpcServer:
     ) -> None:
         t = msg.get("t")
         info = self._client_users[client_id]
+        codec = self._client_codecs.get(client_id)
         if t == protocol.PING:
-            await ws.send_bytes(
-                protocol.encode({"t": protocol.PONG, "ts": time.time()})
+            await self._send(ws, codec, {"t": protocol.PONG, "ts": time.time()})
+        elif t == protocol.SHM_ACK:
+            # the client read the probe nonce out of the segment and
+            # echoed it: both processes provably map the same shm, so
+            # large payloads to this client may ride the store
+            probe = self._shm_nonces.pop(client_id, None)
+            verified = (
+                probe is not None
+                and codec is not None
+                and self._shm_store is not None
+                and bytes(msg.get("nonce") or b"") == probe[1]
+            )
+            if verified:
+                codec.enable_shm(self._shm_store)
+                self.logger.info(
+                    f"shm fast path negotiated with client {client_id}"
+                )
+            if probe is not None and self._shm_store is not None:
+                try:
+                    self._shm_store.delete(probe[0])
+                except Exception:  # noqa: BLE001
+                    pass
+            await self._send(
+                ws,
+                codec,
+                {
+                    "t": protocol.RESULT,
+                    "call_id": msg.get("call_id"),
+                    "result": bool(verified),
+                },
             )
         elif t == protocol.REGISTER:
             definition = msg["definition"]
@@ -558,32 +706,32 @@ class RpcServer:
                 schemas=definition.get("methods", {}),
             )
             self._services[entry.full_id] = entry
-            await ws.send_bytes(
-                protocol.encode(
-                    {
-                        "t": protocol.RESULT,
-                        "call_id": msg.get("call_id"),
-                        "result": {"id": entry.full_id},
-                    }
-                )
+            await self._send(
+                ws,
+                codec,
+                {
+                    "t": protocol.RESULT,
+                    "call_id": msg.get("call_id"),
+                    "result": {"id": entry.full_id},
+                },
             )
         elif t == protocol.UNREGISTER:
             entry = self._services.get(msg["service_id"])
             if entry and entry.owner_client == client_id:
                 del self._services[msg["service_id"]]
-            await ws.send_bytes(
-                protocol.encode(
-                    {
-                        "t": protocol.RESULT,
-                        "call_id": msg.get("call_id"),
-                        "result": True,
-                    }
-                )
+            await self._send(
+                ws,
+                codec,
+                {
+                    "t": protocol.RESULT,
+                    "call_id": msg.get("call_id"),
+                    "result": True,
+                },
             )
         elif t == protocol.TOKEN:
             if not info.is_admin:
                 await self._send_error(
-                    ws, msg.get("call_id"), PermissionError("admin required")
+                    ws, codec, msg.get("call_id"), PermissionError("admin required")
                 )
                 return
             # clients send explicit None for unset fields — `or` fallback,
@@ -594,28 +742,28 @@ class RpcServer:
                 ttl_seconds=msg.get("ttl_seconds"),
                 is_admin=bool(msg.get("is_admin")),
             )
-            await ws.send_bytes(
-                protocol.encode(
-                    {
-                        "t": protocol.RESULT,
-                        "call_id": msg.get("call_id"),
-                        "result": token,
-                    }
-                )
+            await self._send(
+                ws,
+                codec,
+                {
+                    "t": protocol.RESULT,
+                    "call_id": msg.get("call_id"),
+                    "result": token,
+                },
             )
         elif t == protocol.LIST:
-            await ws.send_bytes(
-                protocol.encode(
-                    {
-                        "t": protocol.RESULT,
-                        "call_id": msg.get("call_id"),
-                        "result": self.list_services(msg.get("workspace")),
-                    }
-                )
+            await self._send(
+                ws,
+                codec,
+                {
+                    "t": protocol.RESULT,
+                    "call_id": msg.get("call_id"),
+                    "result": self.list_services(msg.get("workspace")),
+                },
             )
         elif t == protocol.CALL:
             spawn_supervised(
-                self._handle_call(ws, info, msg),
+                self._handle_call(ws, codec, info, msg),
                 name="rpc-handle-call",
                 logger=self.logger,
             )
@@ -632,7 +780,11 @@ class RpcServer:
                 fut.set_exception(err)
 
     async def _handle_call(
-        self, ws: web.WebSocketResponse, info: TokenInfo, msg: dict
+        self,
+        ws: web.WebSocketResponse,
+        codec: Optional[Codec],
+        info: TokenInfo,
+        msg: dict,
     ) -> None:
         try:
             result = await self.call_service_method(
@@ -642,23 +794,30 @@ class RpcServer:
                 msg.get("kwargs", {}),
                 caller=info,
             )
-            await ws.send_bytes(
-                protocol.encode(
-                    {
-                        "t": protocol.RESULT,
-                        "call_id": msg.get("call_id"),
-                        "result": result,
-                    }
-                )
+            await self._send(
+                ws,
+                codec,
+                {
+                    "t": protocol.RESULT,
+                    "call_id": msg.get("call_id"),
+                    "result": result,
+                },
             )
         except Exception as e:
-            await self._send_error(ws, msg.get("call_id"), e)
+            await self._send_error(ws, codec, msg.get("call_id"), e)
+        finally:
+            if codec is not None:
+                # call args decoded from shm refs are dead once the
+                # handler returns — release their pins promptly
+                codec.drain_pins()
 
     async def _send_error(
-        self, ws: web.WebSocketResponse, call_id: Optional[str], error: Exception
+        self,
+        ws: web.WebSocketResponse,
+        codec: Optional[Codec],
+        call_id: Optional[str],
+        error: Exception,
     ) -> None:
-        await ws.send_bytes(
-            protocol.encode(
-                {"t": protocol.ERROR, "call_id": call_id, "error": error}
-            )
+        await self._send(
+            ws, codec, {"t": protocol.ERROR, "call_id": call_id, "error": error}
         )

@@ -53,14 +53,17 @@ Rule = tuple[str, Callable[[np.ndarray], np.ndarray]]
 
 def convert_state_dict(
     state_dict: Mapping[str, np.ndarray],
-    name_map: Mapping[str, Rule],
+    name_map: "Mapping[str, Rule | None]",
     strict: bool = True,
 ) -> dict[str, Any]:
     """Convert ``state_dict`` into a nested Flax params dict.
 
-    ``name_map``: torch key -> ("flax/nested/path", transform). Keys in
-    the state dict but not in the map raise under ``strict`` (catches
-    silent architecture drift), otherwise are skipped.
+    ``name_map``: torch key -> ("flax/nested/path", transform), or
+    ``None`` for keys the checkpoint is known to carry but the Flax
+    module deliberately doesn't use (e.g. DINOv2's ``mask_token`` —
+    inference never masks patches). Keys in the state dict but not in
+    the map raise under ``strict`` (catches silent architecture
+    drift), otherwise are skipped.
     """
     params: dict[str, Any] = {}
     unmapped = []
@@ -68,7 +71,10 @@ def convert_state_dict(
         if tkey not in name_map:
             unmapped.append(tkey)
             continue
-        fpath, transform = name_map[tkey]
+        rule = name_map[tkey]
+        if rule is None:
+            continue  # known key, deliberately dropped
+        fpath, transform = rule
         node = params
         parts = fpath.split("/")
         for p in parts[:-1]:
@@ -81,11 +87,14 @@ def convert_state_dict(
     return params
 
 
-def dinov2_name_map(depth: int = 12) -> dict[str, Rule]:
+def dinov2_name_map(depth: int = 12) -> "dict[str, Rule | None]":
     """Name map: DINOv2 torch checkpoint -> bioengine_tpu.models.vit.ViT."""
     ident = lambda w: w  # noqa: E731
-    m: dict[str, Rule] = {
+    m: "dict[str, Rule | None]" = {
         "cls_token": ("cls_token", lambda w: w.reshape(1, 1, -1)),
+        # present in every published DINOv2 checkpoint; the ViT here
+        # never masks patches at inference, so it is a known-drop
+        "mask_token": None,
         "pos_embed": ("pos_embed", ident),
         "patch_embed.proj.weight": ("patch_embed/kernel", conv_kernel),
         "patch_embed.proj.bias": ("patch_embed/bias", ident),

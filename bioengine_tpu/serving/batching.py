@@ -22,6 +22,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Hashable, Optional
 
+from bioengine_tpu.utils.tasks import spawn_supervised
+
 
 @dataclass
 class _PendingRequest:
@@ -51,6 +53,7 @@ class ContinuousBatcher:
         self.max_wait_ms = max_wait_ms
         self._groups: dict[Hashable, list[_PendingRequest]] = {}
         self._flush_tasks: dict[Hashable, asyncio.Task] = {}
+        self._inflight_flushes: set[asyncio.Task] = set()
         self._stats = {"requests": 0, "batches": 0, "batched_requests": 0}
         # queue-wait samples (seconds), recorded per request at group
         # flush; bounded so stats cost stays flat under load
@@ -66,23 +69,47 @@ class ContinuousBatcher:
         self._stats["requests"] += 1
         if len(group) >= self.max_batch:
             self._cancel_timer(signature)
-            await self._flush(signature)
+            # NEVER run the flush inside the submitting coroutine: if
+            # this submitter is cancelled while batch_fn is mid-flight,
+            # the cancellation would kill the batch and strand every
+            # other future in the group. A supervised task's lifetime
+            # is independent of any one submitter.
+            self._spawn_flush(signature)
         elif signature not in self._flush_tasks:
             self._flush_tasks[signature] = asyncio.create_task(
                 self._timed_flush(signature)
             )
         return await fut
 
+    def _spawn_flush(self, signature: Hashable) -> None:
+        # pop the group SYNCHRONOUSLY (same event-loop tick as the
+        # size check): if the pop waited for the spawned task's first
+        # run, a burst of submits in one tick would all see a full
+        # group and batch_fn would receive more than max_batch
+        group = self._groups.pop(signature, [])
+        if not group:
+            return
+        task = spawn_supervised(
+            self._run_batch(signature, group),
+            name=f"batcher-flush-{signature!r}",
+        )
+        self._inflight_flushes.add(task)
+        task.add_done_callback(self._inflight_flushes.discard)
+
     async def _timed_flush(self, signature: Hashable) -> None:
         try:
             await asyncio.sleep(self.max_wait_ms / 1000.0)
             # Deregister BEFORE the (awaitable) flush: a request arriving
             # for this signature while batch_fn runs must see no timer
-            # and schedule its own, or it would wait forever.
+            # and schedule its own, or it would wait forever. The flush
+            # itself runs detached for the same reason as in submit —
+            # close() cancelling this timer must not kill a mid-flight
+            # batch_fn.
             self._flush_tasks.pop(signature, None)
-            await self._flush(signature)
+            self._spawn_flush(signature)
         except asyncio.CancelledError:
             self._flush_tasks.pop(signature, None)
+            raise
 
     def _cancel_timer(self, signature: Hashable) -> None:
         task = self._flush_tasks.pop(signature, None)
@@ -93,6 +120,11 @@ class ContinuousBatcher:
         group = self._groups.pop(signature, [])
         if not group:
             return
+        await self._run_batch(signature, group)
+
+    async def _run_batch(
+        self, signature: Hashable, group: list[_PendingRequest]
+    ) -> None:
         self._stats["batches"] += 1
         self._stats["batched_requests"] += len(group)
         now = time.monotonic()
@@ -119,6 +151,12 @@ class ContinuousBatcher:
         for signature in list(self._groups):
             self._cancel_timer(signature)
             await self._flush(signature)
+        # drain flushes already in flight — close() is a real barrier,
+        # not a fire-and-forget (results land before shutdown proceeds)
+        while self._inflight_flushes:
+            await asyncio.gather(
+                *list(self._inflight_flushes), return_exceptions=True
+            )
 
     @property
     def stats(self) -> dict:

@@ -195,6 +195,16 @@ class Replica:
                 d["pipeline_stats"] = stats_fn()
             except Exception as e:  # noqa: BLE001 — stats never break health
                 d["pipeline_stats"] = {"error": str(e)}
+        # deployments that hold their own control-plane connection
+        # (data proxies, federated apps) expose ``rpc_stats()`` — the
+        # transport counters ride the same describe path so
+        # get_app_status shows per-replica bytes moved and shm hit-rate
+        rpc_fn = getattr(self.instance, "rpc_stats", None)
+        if callable(rpc_fn):
+            try:
+                d["rpc_stats"] = rpc_fn()
+            except Exception as e:  # noqa: BLE001 — stats never break health
+                d["rpc_stats"] = {"error": str(e)}
         return d
 
 

@@ -476,6 +476,13 @@ class BioEngineWorker:
                 apps = self.apps_manager.get_app_status()
             except Exception as e:
                 apps = {"error": str(e)}
+        try:
+            # control-plane data-plane counters (bytes/frames/chunked
+            # sends, encode/decode seconds, shm hit-rate) — the
+            # transport half of "is the worker healthy"
+            rpc = self.server.describe()
+        except Exception as e:
+            rpc = {"error": str(e)}
         return {
             "worker": {
                 "ready": self.is_ready,
@@ -487,6 +494,7 @@ class BioEngineWorker:
                 "monitor_errors": self._monitor_errors,
                 "geo_location": self._geo_location or {},
             },
+            "rpc": rpc,
             "cluster": self.cluster.status,
             "applications": apps,
             "datasets": {

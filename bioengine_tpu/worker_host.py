@@ -288,7 +288,7 @@ class WorkerHost:
         return {"replica_id": replica_id, "stopped": replica is not None}
 
     def describe(self) -> dict:
-        return {
+        d = {
             "host_id": self.host_id,
             "worker_tag": self.worker_tag,
             "topology": self.topology.as_dict(),
@@ -296,6 +296,12 @@ class WorkerHost:
                 rid: r.describe() for rid, r in self.replicas.items()
             },
         }
+        if self.connection is not None:
+            # transport counters for the host<->controller link: on a
+            # shared machine the shm hit-rate here is the signal that
+            # replica payloads are riding the fast path
+            d["transport"] = self.connection.describe()
+        return d
 
 
 def main(argv: Optional[list[str]] = None) -> int:

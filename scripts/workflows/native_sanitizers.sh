@@ -17,6 +17,7 @@ make -C native sanitizers
 
 run_suite() {
     local san="$1" kfilter="$2" runtime lib
+    shift 2
     runtime="$(gcc -print-file-name=lib${san}.so)"
     lib="$PWD/native/build/libbioengine_store_${san}.so"
     # gcc echoes the bare name back when the runtime isn't installed —
@@ -25,7 +26,7 @@ run_suite() {
         echo "error: lib${san}.so runtime not found (gcc returned '$runtime')" >&2
         exit 1
     fi
-    echo "== native store suite under ${san} (preload ${runtime})"
+    echo "== suite under ${san} (preload ${runtime}): $*"
     # -m 'not slow': the slow sanitizer test spawns its own preloaded
     # subprocess — redundant here where the whole suite already runs
     # against the instrumented library
@@ -34,14 +35,17 @@ run_suite() {
         ASAN_OPTIONS="detect_leaks=0" \
         TSAN_OPTIONS="halt_on_error=1" \
         JAX_PLATFORMS=cpu \
-        python -m pytest tests/test_native_store.py -q -m 'not slow' \
+        python -m pytest "$@" -q -m 'not slow' \
         -k "$kfilter" -p no:cacheprovider
 }
 
-run_suite asan ""
+# the RPC transport module runs here too: its shm fast path pins,
+# maps, releases, and deletes store objects from the wire protocol —
+# pin/release misuse must trip ASan, not production
+run_suite asan "" tests/test_native_store.py tests/test_rpc_transport.py
 # TSan deadlocks in multiprocessing's spawn startup (fork + TSan's
 # internal locks), hanging the cross-process test before exec.  TSan's
 # job here is intra-process race detection on the shm segment (the
 # allocator stress + concurrency tests); cross-process visibility is
 # covered by the ASan leg and the regular suite.
-run_suite tsan "not cross_process"
+run_suite tsan "not cross_process" tests/test_native_store.py

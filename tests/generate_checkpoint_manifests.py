@@ -1,0 +1,128 @@
+"""Regenerate the published-checkpoint key→shape manifest fixtures.
+
+VERDICT r5 item 3: the weight converters were validated only against
+``synthetic_cpsam_state_dict`` — a layout the same repo also wrote.
+These manifests pin the converters to the *published* checkpoint
+layouts instead, so drift in either direction (a cellpose/DINOv2
+release moving a key, or a local name-map edit) fails the suite
+without any download.
+
+The TPU images have no egress, so the manifests are derived from the
+upstream model definitions rather than dumped from the files:
+
+- **DINOv2 ViT-B/14** (``dinov2_vitb14_pretrain.pth``):
+  facebookresearch/dinov2 ``vision_transformer.DinoVisionTransformer``
+  at embed_dim 768 / depth 12 / patch 14, pretrained at 518×518
+  (pos_embed = (518/14)² + 1 cls = 1370 tokens) with ``mask_token``
+  (1, 768) and per-block LayerScale ``ls1/ls2.gamma``.
+- **cpsam** (Cellpose-SAM, the reference finetuning app's default
+  ``pretrained_model``): ``cellpose.vit_sam.Transformer`` =
+  segment-anything ``ImageEncoderViT`` ViT-L under an ``encoder.``
+  prefix (patch 8, dim 1024, depth 24, heads 16, window 14, global
+  attention at blocks 5/11/17/23, pretrain grid 32, neck 256) plus a
+  ``ConvTranspose2d(256, 3, 8, 8)`` readout ``out``.
+
+If a future release changes a layout, re-derive here, update the
+name map, and the manifest test enforces the new contract.
+
+Run from the repo root: ``python tests/generate_checkpoint_manifests.py``
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+OUT_DIR = Path(__file__).resolve().parent
+
+
+def dinov2_vitb14_manifest() -> dict[str, list[int]]:
+    dim, depth, mlp = 768, 12, 3072
+    m = {
+        "cls_token": [1, 1, dim],
+        "mask_token": [1, dim],
+        "pos_embed": [1, 1370, dim],   # 518/14 = 37; 37*37 + 1
+        "patch_embed.proj.weight": [dim, 3, 14, 14],
+        "patch_embed.proj.bias": [dim],
+        "norm.weight": [dim],
+        "norm.bias": [dim],
+    }
+    for i in range(depth):
+        b = f"blocks.{i}"
+        m.update(
+            {
+                f"{b}.norm1.weight": [dim],
+                f"{b}.norm1.bias": [dim],
+                f"{b}.attn.qkv.weight": [3 * dim, dim],
+                f"{b}.attn.qkv.bias": [3 * dim],
+                f"{b}.attn.proj.weight": [dim, dim],
+                f"{b}.attn.proj.bias": [dim],
+                f"{b}.ls1.gamma": [dim],
+                f"{b}.ls2.gamma": [dim],
+                f"{b}.norm2.weight": [dim],
+                f"{b}.norm2.bias": [dim],
+                f"{b}.mlp.fc1.weight": [mlp, dim],
+                f"{b}.mlp.fc1.bias": [mlp],
+                f"{b}.mlp.fc2.weight": [dim, mlp],
+                f"{b}.mlp.fc2.bias": [dim],
+            }
+        )
+    return m
+
+
+def cpsam_vitl_manifest() -> dict[str, list[int]]:
+    dim, depth, heads, mlp, neck = 1024, 24, 16, 4096, 256
+    patch, grid, window = 8, 32, 14
+    global_attn = (5, 11, 17, 23)
+    head_dim = dim // heads
+    m = {
+        "encoder.patch_embed.proj.weight": [dim, 3, patch, patch],
+        "encoder.patch_embed.proj.bias": [dim],
+        # SAM stores pos_embed pre-shaped (1, gh, gw, dim) — NHWC
+        "encoder.pos_embed": [1, grid, grid, dim],
+        "encoder.neck.0.weight": [neck, dim, 1, 1],
+        "encoder.neck.1.weight": [neck],
+        "encoder.neck.1.bias": [neck],
+        "encoder.neck.2.weight": [neck, neck, 3, 3],
+        "encoder.neck.3.weight": [neck],
+        "encoder.neck.3.bias": [neck],
+        # torch ConvTranspose2d(256, 3, 8, 8): (in, out, kH, kW)
+        "out.weight": [neck, 3, patch, patch],
+        "out.bias": [3],
+    }
+    for i in range(depth):
+        b = f"encoder.blocks.{i}"
+        s = grid if i in global_attn else window
+        m.update(
+            {
+                f"{b}.norm1.weight": [dim],
+                f"{b}.norm1.bias": [dim],
+                f"{b}.attn.qkv.weight": [3 * dim, dim],
+                f"{b}.attn.qkv.bias": [3 * dim],
+                f"{b}.attn.proj.weight": [dim, dim],
+                f"{b}.attn.proj.bias": [dim],
+                f"{b}.attn.rel_pos_h": [2 * s - 1, head_dim],
+                f"{b}.attn.rel_pos_w": [2 * s - 1, head_dim],
+                f"{b}.norm2.weight": [dim],
+                f"{b}.norm2.bias": [dim],
+                f"{b}.mlp.lin1.weight": [mlp, dim],
+                f"{b}.mlp.lin1.bias": [mlp],
+                f"{b}.mlp.lin2.weight": [dim, mlp],
+                f"{b}.mlp.lin2.bias": [dim],
+            }
+        )
+    return m
+
+
+def main() -> None:
+    for name, manifest in (
+        ("fixtures_manifest_dinov2_vitb14.json", dinov2_vitb14_manifest()),
+        ("fixtures_manifest_cpsam_vitl.json", cpsam_vitl_manifest()),
+    ):
+        path = OUT_DIR / name
+        path.write_text(json.dumps(manifest, indent=1, sort_keys=True) + "\n")
+        print(f"wrote {path} ({len(manifest)} keys)")
+
+
+if __name__ == "__main__":
+    main()

@@ -78,6 +78,43 @@ def test_normal_run_prints_one_parsed_line():
     assert po["pipeline_stats"]["chunks"] > 0
 
 
+def test_rpc_transport_stage_schema():
+    """Pin the rpc_transport artifact schema: three paths (legacy /
+    zero-copy oob / shm), per-size e2e + codec round-trip numbers, the
+    headline speedups, and the >frame-limit chunked round trip. Sizes
+    are shrunk via env so the test exercises the full stage shape —
+    including chunking — in seconds."""
+    proc, lines = _run(
+        {
+            "BENCH_CONFIGS": "rpc_transport",
+            "BENCH_DEADLINE": "170",
+            "BENCH_RPC_SIZES_MB": "1,8",
+            "BENCH_RPC_BIG_MB": "24",
+            "BIOENGINE_RPC_FRAME_LIMIT_MB": "8",
+        },
+        timeout=200.0,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    st = json.loads(lines[-1])["extra"]["rpc_transport"]
+    assert st["ok"], st
+    for key in (
+        "sizes_mb",
+        "paths",
+        "speedup_oob_vs_legacy",
+        "codec_roundtrip_speedup_oob_vs_legacy",
+        "speedup_shm_vs_legacy",
+        "big_roundtrip",
+    ):
+        assert key in st, key
+    for path in ("legacy", "oob", "shm"):
+        per_size = st["paths"][path]["mb8"]
+        for key in ("p50_ms", "p95_ms", "mb_per_sec", "codec_ms_per_roundtrip"):
+            assert key in per_size, (path, key)
+    # the leg above the frame limit must have round-tripped chunked
+    assert st["big_roundtrip"]["ok"]
+    assert st["big_roundtrip"]["chunked"]
+
+
 def test_stalled_worker_killed_with_diagnostics_never_rc124():
     # the env-gated 'sleep' stage hangs mid-stage DETERMINISTICALLY (no
     # dependence on compile latency or a warm compilation cache), so a

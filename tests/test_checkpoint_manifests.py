@@ -1,0 +1,116 @@
+"""Converter ↔ published-checkpoint layout contract.
+
+The key→shape manifests (``tests/fixtures_manifest_*.json``, derived
+from the upstream model definitions — see
+``generate_checkpoint_manifests.py`` for provenance) stand in for the
+published cpsam and DINOv2 ViT-B/14 checkpoint files, which CI cannot
+download. The name maps must cover each manifest EXACTLY: an unmapped
+checkpoint key (upstream added/renamed something) fails, and a mapped
+key missing from the manifest (the map invents keys the published file
+doesn't have) fails too — drift in either direction breaks the suite
+without any egress.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from bioengine_tpu.runtime.convert import (
+    convert_state_dict,
+    cpsam_name_map,
+    dinov2_name_map,
+    flatten_params,
+    infer_depth,
+)
+
+FIXTURES = Path(__file__).resolve().parent
+
+CASES = {
+    "dinov2_vitb14": (
+        "fixtures_manifest_dinov2_vitb14.json", dinov2_name_map, 12,
+    ),
+    "cpsam_vitl": (
+        "fixtures_manifest_cpsam_vitl.json", cpsam_name_map, 24,
+    ),
+}
+
+
+def _load(case):
+    fname, map_fn, depth = CASES[case]
+    manifest = json.loads((FIXTURES / fname).read_text())
+    return manifest, map_fn(depth), depth
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_name_map_covers_manifest_exactly(case):
+    manifest, name_map, _ = _load(case)
+    missing = sorted(set(manifest) - set(name_map))
+    phantom = sorted(set(name_map) - set(manifest))
+    assert not missing, (
+        f"checkpoint keys with no conversion rule (upstream layout "
+        f"drift?): {missing[:5]} (+{max(len(missing) - 5, 0)} more)"
+    )
+    assert not phantom, (
+        f"conversion rules for keys the published checkpoint does not "
+        f"carry: {phantom[:5]} (+{max(len(phantom) - 5, 0)} more)"
+    )
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_manifest_converts_strict(case):
+    """A manifest-shaped state dict converts under strict=True and the
+    transforms produce the Flax-side layouts (conv kernels HWIO,
+    linear kernels (in, out))."""
+    manifest, name_map, depth = _load(case)
+    # np.zeros is lazy (calloc) — the ViT-L manifest is ~1.2 GB virtual
+    # but each transform only materializes one tensor at a time
+    sd = {k: np.zeros(shape, np.float32) for k, shape in manifest.items()}
+    assert infer_depth(sd) == depth
+    params = convert_state_dict(sd, name_map, strict=True)
+    flat = flatten_params(params)
+
+    if case == "dinov2_vitb14":
+        # mask_token is a known-drop: present in the checkpoint, absent
+        # from the converted tree (the ViT never masks at inference)
+        assert not any("mask_token" in k for k in flat)
+        assert flat["patch_embed/kernel"].shape == (14, 14, 3, 768)
+        assert flat["block0/attn/qkv/kernel"].shape == (768, 2304)
+        assert flat["cls_token"].shape == (1, 1, 768)
+    else:
+        assert flat["encoder/patch_embed/kernel"].shape == (8, 8, 3, 1024)
+        assert flat["encoder/neck_conv1/kernel"].shape == (1, 1, 1024, 256)
+        # ConvTranspose: (in, out, kH, kW) -> (kH, kW, in, out), flipped
+        assert flat["out/kernel"].shape == (8, 8, 256, 3)
+        assert flat["encoder/block0/mlp_lin1/kernel"].shape == (1024, 4096)
+        # windowed vs global relative-position table sizes
+        assert flat["encoder/block0/attn/rel_pos_h"].shape == (27, 64)
+        assert flat["encoder/block5/attn/rel_pos_h"].shape == (63, 64)
+
+    # every non-dropped rule landed exactly one leaf
+    n_dropped = sum(1 for v in name_map.values() if v is None)
+    assert len(flat) == len(manifest) - n_dropped
+
+
+def test_manifest_matches_synthetic_generator_layout():
+    """The synthetic cpsam generator (what the conversion/CLI tests
+    feed) and the published-checkpoint manifest must agree on the key
+    set at matching hyperparameters — otherwise the suite validates a
+    layout the real file doesn't have."""
+    from bioengine_tpu.runtime.convert import synthetic_cpsam_state_dict
+
+    manifest, _, _ = _load("cpsam_vitl")
+    sd = synthetic_cpsam_state_dict(
+        patch_size=8,
+        dim=16,               # tiny dim: only the KEY SET is compared
+        depth=24,
+        num_heads=2,
+        window_size=14,
+        global_attn_indexes=(5, 11, 17, 23),
+        neck_dim=8,
+        pretrain_grid=32,
+    )
+    assert set(sd) == set(manifest)

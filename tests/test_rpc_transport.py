@@ -1,0 +1,441 @@
+"""Zero-copy RPC data-plane contract.
+
+Property-style coverage of the out-of-band wire codec (bit identity
+across dtypes/layouts, zero-copy receive proven with
+``np.shares_memory``), chunked multi-frame reassembly (incl. the
+>256 MB round trip the old twin ``max_msg_size`` caps made
+impossible), the same-host shm fast path (exactly ONE host copy,
+proven by counting store puts and aliasing the decoded array against
+the store segment), legacy interop, and pin lifecycle.
+
+This module also runs under the ASan-instrumented native store build
+(scripts/workflows/native_sanitizers.sh) so shm pin/release misuse
+trips the sanitizer, not production.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from bioengine_tpu.native.store import LocalObjectStore
+from bioengine_tpu.rpc import protocol
+from bioengine_tpu.rpc.client import connect_to_server
+from bioengine_tpu.rpc.protocol import (
+    INLINE_LIMIT,
+    RemoteError,
+    decode,
+    decode_oob,
+    encode,
+    encode_oob,
+)
+from bioengine_tpu.rpc.server import RpcServer
+from bioengine_tpu.rpc.transport import (
+    Codec,
+    FrameAssembler,
+    RpcStats,
+    ShmPinTracker,
+    TransportConfig,
+    chunk_frames,
+)
+
+pytestmark = [pytest.mark.integration, pytest.mark.anyio]
+
+
+def roundtrip(msg: dict, **kw) -> dict:
+    return decode_oob(encode_oob(msg, **kw))
+
+
+DTYPES = [
+    np.bool_, np.int8, np.uint8, np.int16, np.uint16, np.int32,
+    np.uint32, np.int64, np.uint64, np.float16, np.float32, np.float64,
+    np.complex64,
+]
+
+
+class TestOobCodec:
+    @pytest.mark.parametrize("dtype", DTYPES, ids=lambda d: np.dtype(d).name)
+    def test_dtype_bit_identity(self, dtype):
+        rng = np.random.default_rng(0)
+        arr = (rng.integers(0, 200, 4096)).astype(dtype)
+        out = roundtrip({"a": arr})["a"]
+        assert out.dtype == arr.dtype
+        assert out.shape == arr.shape
+        assert out.tobytes() == arr.tobytes()  # bit identity, NaN-proof
+
+    @pytest.mark.parametrize(
+        "shape", [(), (0,), (3, 0, 2), (1,), (5, 7, 3)], ids=str
+    )
+    def test_odd_shapes(self, shape):
+        arr = np.full(shape, 1.5, np.float32)
+        out = roundtrip({"a": arr})["a"]
+        assert out.shape == arr.shape
+        assert out.tobytes() == arr.tobytes()
+
+    def test_noncontiguous_and_fortran_order(self):
+        base = np.arange(512 * 512, dtype=np.float32).reshape(512, 512)
+        sliced = base[::2, 1::3]          # non-contiguous view
+        fortran = np.asfortranarray(base)
+        out = roundtrip({"s": sliced, "f": fortran})
+        np.testing.assert_array_equal(out["s"], sliced)
+        np.testing.assert_array_equal(out["f"], fortran)
+
+    def test_bfloat16_as_uint16(self):
+        # numpy has no native bfloat16; the wire convention is a uint16
+        # view reinterpreted by the receiver
+        import ml_dtypes
+
+        arr = np.linspace(-3, 3, 2048).astype(ml_dtypes.bfloat16)
+        out = roundtrip({"a": arr.view(np.uint16)})["a"]
+        back = out.view(ml_dtypes.bfloat16)
+        assert back.tobytes() == arr.tobytes()
+
+    def test_zero_copy_receive(self):
+        arr = np.arange(1 << 18, dtype=np.float32)  # 1 MB, > INLINE_LIMIT
+        wire = bytes(encode_oob({"a": arr}))  # what the socket delivers
+        out = decode_oob(wire)["a"]
+        # the decoded array is a view OVER THE RECEIVED FRAME: zero
+        # payload copies on the receive side
+        assert np.shares_memory(out, np.frombuffer(wire, np.uint8))
+        assert not out.flags.writeable  # views over the wire are RO
+        np.testing.assert_array_equal(out, arr)
+
+    def test_small_arrays_stay_inline(self):
+        arr = np.arange(8, dtype=np.int16)  # < INLINE_LIMIT
+        frame = encode_oob({"a": arr})
+        meta_len = int.from_bytes(frame[4:8], "little")
+        assert len(frame) <= ((8 + meta_len + 63) & ~63)  # no payload section
+        np.testing.assert_array_equal(decode_oob(frame)["a"], arr)
+
+    def test_large_bytes_extracted(self):
+        blob = bytes(range(256)) * 4096  # 1 MB
+        frame = encode_oob({"b": blob, "small": b"ok"})
+        out = decode_oob(frame)
+        assert out["b"] == blob
+        assert out["small"] == b"ok"
+
+    def test_exception_and_scalars(self):
+        out = roundtrip(
+            {"e": ValueError("boom"), "i": np.int64(7), "f": np.float32(2.5)}
+        )
+        assert isinstance(out["e"], RemoteError)
+        assert "boom" in str(out["e"])
+        assert out["i"] == 7 and out["f"] == 2.5
+
+    def test_nested_structures(self):
+        arr = np.arange(1 << 16, dtype=np.float64)
+        msg = {"args": [[{"deep": arr}], (1, 2)], "kwargs": {"k": [arr[:10]]}}
+        out = roundtrip(msg)
+        np.testing.assert_array_equal(out["args"][0][0]["deep"], arr)
+        np.testing.assert_array_equal(out["kwargs"]["k"][0], arr[:10])
+
+    def test_legacy_interop_both_directions(self):
+        arr = np.arange(1 << 16, dtype=np.float32)
+        # pre-oob peer's bytes decode through the new dispatcher
+        codec = Codec()
+        out = codec.decode(encode({"a": arr}))
+        np.testing.assert_array_equal(out["a"], arr)
+        # a codec without negotiated oob emits bytes an OLD decode reads
+        legacy_codec = Codec()
+        assert legacy_codec.oob is False
+        frames = legacy_codec.encode_frames({"a": arr})
+        assert len(frames) == 1
+        np.testing.assert_array_equal(decode(frames[0])["a"], arr)
+
+    def test_magic_cannot_collide_with_legacy(self):
+        assert not protocol.is_oob_frame(encode({"t": "ping"}))
+        assert protocol.is_oob_frame(encode_oob({"t": "ping"}))
+
+
+class TestChunking:
+    def test_chunk_reassembly(self):
+        arr = np.arange(1 << 19, dtype=np.float32)  # 2 MB
+        frame = encode_oob({"a": arr})
+        chunks = chunk_frames(frame, 256 * 1024)
+        assert len(chunks) == (len(frame) + 256 * 1024 - 1) // (256 * 1024)
+        asm = FrameAssembler()
+        results = [asm.feed(c) for c in chunks]
+        assert all(r is None for r in results[:-1])
+        out = decode_oob(results[-1])["a"]
+        np.testing.assert_array_equal(out, arr)
+        assert asm.pending == 0
+
+    def test_interleaved_chunk_streams(self):
+        a = np.arange(1 << 17, dtype=np.int32)
+        b = (np.arange(1 << 17, dtype=np.int32) * 3)[::-1].copy()
+        ca = chunk_frames(encode_oob({"x": a}), 64 * 1024)
+        cb = chunk_frames(encode_oob({"x": b}), 64 * 1024)
+        asm = FrameAssembler()
+        done = []
+        # alternate the two streams — concurrent sends interleave at
+        # websocket-message granularity exactly like this
+        for pair in zip(ca, cb):
+            for c in pair:
+                whole = asm.feed(c)
+                if whole is not None:
+                    done.append(decode_oob(whole)["x"])
+        for c in ca[len(cb):] + cb[len(ca):]:
+            whole = asm.feed(c)
+            if whole is not None:
+                done.append(decode_oob(whole)["x"])
+        assert len(done) == 2
+        np.testing.assert_array_equal(done[0], a)
+        np.testing.assert_array_equal(done[1], b)
+
+    def test_hostile_chunk_header_rejected_before_allocation(self):
+        """A peer-controlled header claiming a huge assembled total
+        must be rejected, not allocated (the replacement for the old
+        per-message memory bound that chunking removed)."""
+        import msgpack as _mp
+
+        asm = FrameAssembler(max_assembled=1024 * 1024)
+        hdr = _mp.packb(
+            {"id": b"x" * 8, "q": 0, "n": 2, "z": 1 << 40, "o": 0, "c": 2}
+        )
+        evil = b"".join(
+            [protocol.CHUNK_MAGIC, len(hdr).to_bytes(4, "little"), hdr, b"hi"]
+        )
+        with pytest.raises(ValueError, match="assembled bytes"):
+            asm.feed(evil)
+        # a duplicated-offset stream (two seqs claiming the same bytes)
+        # must not be able to "complete" with zero-filled holes
+        hdr2 = _mp.packb(
+            {"id": b"y" * 8, "q": 1, "n": 2, "z": 4, "o": 0, "c": 2}
+        )
+        evil2 = b"".join(
+            [protocol.CHUNK_MAGIC, len(hdr2).to_bytes(4, "little"), hdr2, b"hi"]
+        )
+        with pytest.raises(ValueError, match="inconsistent chunk header"):
+            asm.feed(evil2)
+        assert asm.pending == 0
+
+    def test_reassembled_frames_are_read_only(self):
+        arr = np.arange(1 << 17, dtype=np.float32)
+        chunks = chunk_frames(encode_oob({"a": arr}), 64 * 1024)
+        asm = FrameAssembler()
+        whole = [asm.feed(c) for c in chunks][-1]
+        out = decode_oob(whole)["a"]
+        # same immutable contract as unchunked (bytes-backed) messages
+        assert not out.flags.writeable
+        np.testing.assert_array_equal(out, arr)
+
+    def test_stale_partial_streams_expire(self):
+        arr = np.arange(1 << 16, dtype=np.float32)
+        chunks = chunk_frames(encode_oob({"a": arr}), 16 * 1024)
+        asm = FrameAssembler(stale_after=0.0)  # everything is stale
+        asm.feed(chunks[0])
+        assert asm.pending == 1
+        # the next chunk's housekeeping sweep drops the abandoned
+        # stream (its own entry is re-created after the sweep)
+        asm.feed(chunk_frames(encode_oob({"b": arr}), 16 * 1024)[0])
+        assert asm.pending == 1
+
+    def test_codec_chunks_above_frame_limit(self):
+        cfg = TransportConfig(frame_limit=128 * 1024)
+        enc = Codec(config=cfg)
+        enc.oob = True
+        dec = Codec(config=cfg)
+        arr = np.arange(1 << 18, dtype=np.float32)  # 1 MB -> 9 chunks
+        frames = enc.encode_frames({"a": arr})
+        assert len(frames) > 1
+        assert all(len(f) <= 128 * 1024 + 512 for f in frames)
+        outs = [dec.decode(f) for f in frames]
+        assert all(o is None for o in outs[:-1])
+        np.testing.assert_array_equal(outs[-1]["a"], arr)
+        assert enc.stats.chunked_msgs_out == 1
+        assert dec.stats.chunked_msgs_in == 1
+
+
+class _CountingStore(LocalObjectStore):
+    """LocalObjectStore that counts the bytes written by put — the
+    instrument behind the one-copy proof."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.put_calls: list[int] = []
+
+    def try_put(self, key, data) -> bool:
+        ok = super().try_put(key, data)
+        if ok:
+            self.put_calls.append(len(bytes(data)) if not hasattr(data, "nbytes") else data.nbytes)
+        return ok
+
+
+class TestShmFastPath:
+    def _pair(self, store, threshold=1024):
+        cfg = TransportConfig(shm_threshold=threshold)
+        enc = Codec(config=cfg)
+        enc.oob = True
+        enc.enable_shm(store)
+        dec = Codec(config=cfg)
+        dec.oob = True
+        dec.enable_shm(store)
+        return enc, dec
+
+    def test_64mb_roundtrip_exactly_one_host_copy(self):
+        store = _CountingStore("one-copy", capacity=256 * 1024 * 1024)
+        enc, dec = self._pair(store)
+        arr = np.arange(16 * 1024 * 1024, dtype=np.float32)  # 64 MB
+        frames = enc.encode_frames({"t": "call", "a": arr})
+        # copy #1 (the only one): the store put
+        assert store.put_calls == [arr.nbytes]
+        assert len(frames) == 1
+        assert len(frames[0]) < 4096, "payload must NOT ride the wire"
+        out = dec.decode(frames[0])["a"]
+        # receive side: the decoded array aliases the STORE SEGMENT —
+        # zero further copies
+        key = next(k for k in store._data)
+        assert np.shares_memory(out, np.frombuffer(store._data[key], np.uint8))
+        np.testing.assert_array_equal(out, arr)
+        assert enc.stats.shm_puts == 1 and dec.stats.shm_gets == 1
+
+    def test_native_store_one_copy_roundtrip(self):
+        from bioengine_tpu.native.store import (
+            SharedObjectStore,
+            native_available,
+        )
+
+        if not native_available():
+            pytest.skip("no native toolchain")
+        store = SharedObjectStore(
+            "rpc-transport-test", capacity=64 * 1024 * 1024, create=True
+        )
+        try:
+            enc, dec = self._pair(store)
+            arr = np.arange(4 * 1024 * 1024, dtype=np.float32)  # 16 MB
+            frames = enc.encode_frames({"a": arr})
+            assert len(frames[0]) < 4096
+            out = dec.decode(frames[0])["a"]
+            np.testing.assert_array_equal(out, arr)
+            # the decoded array aliases the shm mapping itself
+            key = next(iter(dec._tracker._finalizers))
+            probe = store.get(key)
+            try:
+                assert np.shares_memory(out, np.frombuffer(probe, np.uint8))
+            finally:
+                probe.release()
+                store.release(key)
+            del out, probe
+            dec.drain_pins()
+            assert store.stats()["n_objects"] == 0  # released AND deleted
+        finally:
+            store.destroy()
+
+    def test_fallback_when_store_full(self):
+        store = LocalObjectStore("tiny", capacity=1024 * 1024)
+        enc, dec = self._pair(store)
+        arr = np.arange(1 << 19, dtype=np.float32)  # 2 MB > capacity
+        frames = enc.encode_frames({"a": arr})
+        assert enc.stats.shm_fallbacks == 1
+        assert len(frames[0]) > arr.nbytes  # payload rode the wire
+        np.testing.assert_array_equal(dec.decode(frames[0])["a"], arr)
+
+    def test_pin_released_only_after_consumer_drops_views(self):
+        store = LocalObjectStore("pins", capacity=64 * 1024 * 1024)
+        enc, dec = self._pair(store)
+        frames = enc.encode_frames({"a": np.arange(1 << 18, dtype=np.float32)})
+        out = dec.decode(frames[0])["a"]
+        dec.drain_pins()
+        assert store.stats()["n_objects"] == 1  # consumer still holds a view
+        del out
+        dec.drain_pins()
+        assert store.stats()["n_objects"] == 0  # released + deleted
+
+    def test_missing_shm_object_raises_loudly(self):
+        store = LocalObjectStore("gone", capacity=64 * 1024 * 1024)
+        enc, dec = self._pair(store)
+        frames = enc.encode_frames({"a": np.arange(1 << 18, dtype=np.float32)})
+        store.clear()  # simulate eviction before consume
+        with pytest.raises(KeyError, match="evicted before consume"):
+            dec.decode(frames[0])
+
+
+class TestStats:
+    def test_counters_accumulate(self):
+        stats = RpcStats()
+        codec = Codec(stats=stats)
+        codec.oob = True
+        arr = np.arange(1 << 18, dtype=np.float32)
+        for frame in codec.encode_frames({"a": arr}):
+            codec.decode(frame)
+        assert stats.msgs_out == 1 and stats.msgs_in == 1
+        assert stats.bytes_out == stats.bytes_in > arr.nbytes
+        assert stats.encode_seconds > 0 and stats.decode_seconds >= 0
+        d = stats.as_dict()
+        assert d["shm_hit_rate"] is None  # no shm traffic yet
+
+
+# ---------------------------------------------------------------------------
+# end-to-end over a real websocket server
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+async def server_store():
+    store = LocalObjectStore("e2e", capacity=512 * 1024 * 1024)
+    srv = RpcServer(shm_store=store)
+    await srv.start()
+    srv.register_local_service({"id": "echo", "echo": lambda a: a})
+    yield srv, store
+    await srv.stop()
+
+
+class TestEndToEnd:
+    async def test_shm_negotiated_and_used(self, server_store):
+        srv, store = server_store
+        conn = await connect_to_server(
+            {"server_url": f"http://127.0.0.1:{srv.port}", "shm_store": store}
+        )
+        try:
+            assert conn.codec.shm_store is not None
+            arr = np.arange(1 << 19, dtype=np.float32)  # 2 MB > threshold
+            out = await conn.call("bioengine/echo", "echo", arr)
+            np.testing.assert_array_equal(out, arr)
+            assert conn.codec.stats.shm_puts >= 1   # request rode the store
+            assert conn.codec.stats.shm_gets >= 1   # result rode the store
+        finally:
+            await conn.disconnect()
+
+    async def test_legacy_client_interop(self, server_store):
+        srv, _ = server_store
+        conn = await connect_to_server(
+            {
+                "server_url": f"http://127.0.0.1:{srv.port}",
+                "protocols": [],       # pre-oob peer
+                "shm_store": None,
+            }
+        )
+        try:
+            assert conn.codec.oob is False
+            arr = np.arange(1 << 18, dtype=np.float32)
+            out = await conn.call("bioengine/echo", "echo", arr)
+            np.testing.assert_array_equal(out, arr)
+            assert conn.codec.stats.legacy_msgs_out >= 1
+        finally:
+            await conn.disconnect()
+
+    async def test_above_256mb_roundtrip_chunked(self):
+        """The acceptance case: a payload ABOVE the old 256 MB twin
+        caps round-trips through chunked multi-frame sends."""
+        srv = RpcServer(shm_store=None)
+        await srv.start()
+        srv.register_local_service(
+            {"id": "probe", "head_tail_len": lambda a: [
+                int(a[0]), int(a[-1]), int(a.size)
+            ]}
+        )
+        conn = await connect_to_server(
+            {"server_url": f"http://127.0.0.1:{srv.port}", "shm_store": None}
+        )
+        try:
+            n = 257 * 1024 * 1024  # 257 MB uint8 > the old hard cap
+            arr = np.zeros(n, np.uint8)
+            arr[0], arr[-1] = 7, 9
+            out = await conn.call("bioengine/probe", "head_tail_len", arr)
+            assert out == [7, 9, n]
+            assert conn.codec.stats.chunked_msgs_out >= 1
+        finally:
+            await conn.disconnect()
+            await srv.stop()

@@ -311,6 +311,52 @@ class TestBatcher:
         await b.close()
         assert await task == 7
 
+    async def test_burst_never_exceeds_max_batch(self):
+        """A same-tick burst must still flush in max_batch-sized groups
+        (regression guard for the supervised-flush change: the group is
+        popped synchronously at the size check, not when the spawned
+        task first runs)."""
+        sizes = []
+
+        async def batch_fn(sig, payloads):
+            sizes.append(len(payloads))
+            return payloads
+
+        b = ContinuousBatcher(batch_fn, max_batch=8, max_wait_ms=5)
+        results = await asyncio.gather(
+            *[b.submit("s", i) for i in range(16)]
+        )
+        assert results == list(range(16))
+        assert sizes == [8, 8]
+        await b.close()
+
+    async def test_cancelled_submitter_does_not_strand_group(self):
+        """Regression: the submitter whose request fills the group used
+        to run the flush inline — cancelling it killed batch_fn
+        mid-flight and stranded every other future in the group. The
+        flush now runs in a supervised task with its own lifetime."""
+        started = asyncio.Event()
+        release = asyncio.Event()
+
+        async def batch_fn(sig, payloads):
+            started.set()
+            await release.wait()
+            return [p * 10 for p in payloads]
+
+        b = ContinuousBatcher(batch_fn, max_batch=2, max_wait_ms=60_000)
+        first = asyncio.create_task(b.submit("s", 1))
+        await asyncio.sleep(0)             # first request enqueued
+        trigger = asyncio.create_task(b.submit("s", 2))  # fills the group
+        await asyncio.wait_for(started.wait(), 2)  # batch_fn mid-flight
+        trigger.cancel()                   # the triggering submitter dies
+        await asyncio.sleep(0.01)
+        release.set()
+        # the surviving member of the group still gets its result
+        assert await asyncio.wait_for(first, 2) == 10
+        with pytest.raises(asyncio.CancelledError):
+            await trigger
+        await b.close()
+
 
 class TestRegressionFixes:
     async def test_submit_during_inflight_flush_gets_timer(self):
