@@ -245,6 +245,30 @@ class ClusterState:
             host.chips_in_use[d] = replica_id
         return taken
 
+    def host_adopt_chips(
+        self, host_id: str, replica_id: str, device_ids: list[int]
+    ) -> None:
+        """Re-lease SPECIFIC chips to a replica on a REJOINING host
+        (its fresh HostRecord starts with an empty lease table; the
+        replica's chips are already pinned by compiled programs, so the
+        lease must land on the same device ids)."""
+        host = self.hosts.get(host_id)
+        if host is None or not host.alive:
+            raise RuntimeError(f"host '{host_id}' is not available")
+        for d in device_ids:
+            owner = host.chips_in_use.get(d)
+            if owner not in (None, replica_id):
+                raise RuntimeError(
+                    f"host '{host_id}' chip {d} already leased to {owner}"
+                )
+        for d in device_ids:
+            host.chips_in_use[d] = replica_id
+        # rejoin may follow a mark_host_dead that flagged the replica's
+        # record dead; it is demonstrably alive again
+        rec = self._replicas.get(replica_id)
+        if rec is not None:
+            rec.alive = True
+
     # ---- pending workloads (drive the autoscaler) ---------------------------
 
     def add_pending(self, workload_id: str, resources: dict[str, float]) -> None:

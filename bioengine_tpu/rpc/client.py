@@ -22,8 +22,16 @@ from bioengine_tpu.rpc.transport import (
     TransportConfig,
     attach_store_by_name,
 )
+from bioengine_tpu.testing import faults
+from bioengine_tpu.utils.backoff import full_jitter_delay
 from bioengine_tpu.utils.logger import create_logger
 from bioengine_tpu.utils.tasks import spawn_supervised
+
+
+class ConnectionLost(ConnectionError):
+    """The websocket dropped with this call in flight. The outcome on
+    the server is unknown — the serving layer retries only idempotent
+    calls."""
 
 
 class ServiceProxy:
@@ -59,6 +67,8 @@ class ServerConnection:
         shm_store: Any = "auto",
         transport_config: Optional[TransportConfig] = None,
         protocols: Optional[list[str]] = None,
+        auto_reconnect: bool = False,
+        reconnect_max_backoff_s: float = 5.0,
     ):
         self.url = url
         self.token = token
@@ -68,6 +78,13 @@ class ServerConnection:
         self.protocols = (
             [protocol.PROTO_OOB1] if protocols is None else list(protocols)
         )
+        self.auto_reconnect = auto_reconnect
+        self.reconnect_max_backoff_s = reconnect_max_backoff_s
+        # connection-lifecycle hooks (sync or async callables): fired on
+        # an UNEXPECTED drop, and after a successful re-establish +
+        # service re-registration respectively
+        self.on_disconnect: list[Callable[[], Any]] = []
+        self.on_reconnect: list[Callable[[], Any]] = []
         self.client_id: Optional[str] = None
         self.workspace: Optional[str] = None
         self.user_id: Optional[str] = None
@@ -76,12 +93,22 @@ class ServerConnection:
         self._ws: Optional[aiohttp.ClientWebSocketResponse] = None
         self._pending: dict[str, asyncio.Future] = {}
         self._local_services: dict[str, dict[str, Callable]] = {}
+        self._service_definitions: dict[str, dict[str, Any]] = {}
         self._reader_task: Optional[asyncio.Task] = None
+        self._closing = False
+        self._reconnect_task: Optional[asyncio.Task] = None
         self.codec = Codec(config=transport_config or TransportConfig.from_env())
         self._shm_store_cfg = shm_store
         self._owns_shm = False
 
     async def connect(self) -> "ServerConnection":
+        await self._establish()
+        return self
+
+    async def _establish(self) -> None:
+        """One transport bring-up: websocket + welcome + reader + shm
+        negotiation. Shared by ``connect`` and the reconnect loop."""
+        await self._teardown_transport()
         self._session = aiohttp.ClientSession()
         url = self.url
         # declare codec support at handshake; a pre-oob server ignores
@@ -106,7 +133,25 @@ class ServerConnection:
         self._reader_task = asyncio.create_task(self._read_loop())
         if self.codec.oob and isinstance(welcome.get("shm"), dict):
             await self._negotiate_shm(welcome["shm"])
-        return self
+
+    async def _teardown_transport(self) -> None:
+        """Close ws/session remnants without touching pending futures
+        or service state (reconnect keeps both)."""
+        if self._reader_task and self._reader_task is not asyncio.current_task():
+            self._reader_task.cancel()
+            self._reader_task = None
+        if self._ws is not None and not self._ws.closed:
+            try:
+                await self._ws.close()
+            except Exception:  # noqa: BLE001
+                pass
+        if self._session is not None:
+            try:
+                await self._session.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._ws = None
+        self._session = None
 
     async def _negotiate_shm(self, offer: dict) -> None:
         """Same-host handshake: map the server's segment, read the
@@ -138,12 +183,17 @@ class ServerConnection:
             self._owns_shm = False
 
     async def disconnect(self) -> None:
+        self._closing = True
+        if self._reconnect_task is not None:
+            self._reconnect_task.cancel()
+            self._reconnect_task = None
         if self._reader_task:
             self._reader_task.cancel()
         if self._ws:
             await self._ws.close()
         if self._session:
             await self._session.close()
+        self._fail_inflight(ConnectionLost("client disconnected"))
         shm = self.codec.shm_store
         self.codec.close()
         if shm is not None and self._owns_shm:
@@ -213,20 +263,114 @@ class ServerConnection:
                     if fut and not fut.done():
                         fut.set_result(data.get("ts"))
         except asyncio.CancelledError:
-            pass
+            return
+        except Exception as e:  # noqa: BLE001 — transport died under us
+            self.logger.error(f"read loop failed: {e}")
+        # the websocket closed without disconnect(): classify every
+        # in-flight future NOW (a caller must see a typed transport
+        # error immediately, not a timeout), then heal if configured
+        self._on_connection_lost()
+
+    def _on_connection_lost(self) -> None:
+        if self._closing:
+            return
+        self.logger.warning("connection to server lost")
+        self._fail_inflight(
+            ConnectionLost(f"connection to {self.url} lost mid-call")
+        )
+        for cb in self.on_disconnect:
+            try:
+                result = cb()
+                if asyncio.iscoroutine(result):
+                    spawn_supervised(
+                        result, name="rpc-on-disconnect", logger=self.logger
+                    )
+            except Exception as e:  # noqa: BLE001 — hooks never kill the client
+                self.logger.error(f"on_disconnect callback failed: {e}")
+        if self.auto_reconnect and (
+            self._reconnect_task is None or self._reconnect_task.done()
+        ):
+            # exactly one reconnect loop at a time: a re-drop while a
+            # loop is mid-retry must not spawn a second one (each
+            # _establish tears down the transport — two racing loops
+            # would keep closing each other's fresh connection)
+            self._reconnect_task = spawn_supervised(
+                self._reconnect_loop(),
+                name="rpc-reconnect",
+                logger=self.logger,
+            )
+
+    def _fail_inflight(self, exc: Exception) -> None:
+        pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+                # a caller that already bailed (e.g. its send raised
+                # first) never awaits this future — mark the exception
+                # retrieved so the loop doesn't report it at GC time
+                fut.exception()
+
+    async def _reconnect_loop(self) -> None:
+        """Re-establish with exponential backoff + full jitter, then
+        re-register every local service and fire ``on_reconnect``."""
+        attempt = 0
+        while not self._closing:
+            await asyncio.sleep(
+                full_jitter_delay(attempt, 0.2, self.reconnect_max_backoff_s)
+            )
+            attempt += 1
+            try:
+                await self._establish()
+                await self._reregister_services()
+                for cb in self.on_reconnect:
+                    result = cb()
+                    if asyncio.iscoroutine(result):
+                        await result
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — keep trying
+                self.logger.warning(
+                    f"reconnect attempt {attempt} failed: {e}"
+                )
+                continue
+            self.logger.info(f"reconnected after {attempt} attempt(s)")
+            return
+
+    async def _reregister_services(self) -> None:
+        # one registration implementation: register_service rebuilds the
+        # wire definition and refreshes both local maps
+        for definition in list(self._service_definitions.values()):
+            await self.register_service(definition)
 
     async def _send_msg(self, msg: dict) -> None:
-        assert self._ws is not None, "not connected"
+        if faults.ACTIVE:
+            await faults.hit("rpc.client.send", drop=self._abort_connection)
+        ws = self._ws
+        if ws is None or ws.closed:
+            raise ConnectionLost("rpc connection is down")
         for frame in await self.codec.encode_frames_async(msg):
-            await self._ws.send_bytes(frame)
+            await ws.send_bytes(frame)
+
+    async def _abort_connection(self) -> None:
+        """Sever the transport WITHOUT the closing handshake semantics
+        of disconnect() — the fault-injection analog of a network
+        partition; the read loop notices and runs the lost-connection
+        path (in-flight failure + reconnect)."""
+        if self._ws is not None and not self._ws.closed:
+            await self._ws.close()
 
     async def _request(self, msg: dict) -> Any:
         call_id = uuid.uuid4().hex
         msg["call_id"] = call_id
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[call_id] = fut
-        await self._send_msg(msg)
-        return await asyncio.wait_for(fut, self.timeout)
+        try:
+            await self._send_msg(msg)
+            return await asyncio.wait_for(fut, self.timeout)
+        finally:
+            # RESULT/ERROR pop on arrival; this covers timeout/cancel so
+            # abandoned futures don't accumulate across reconnects
+            self._pending.pop(call_id, None)
 
     async def _handle_incoming_call(self, msg: dict) -> None:
         """The server is routing another client's call to one of OUR
@@ -273,6 +417,9 @@ class ServerConnection:
         )
         full_id = result["id"]
         self._local_services[full_id] = methods
+        # remember the ORIGINAL definition (with callables) so a
+        # reconnect can re-register this service transparently
+        self._service_definitions[full_id] = dict(definition)
         return {"id": full_id}
 
     async def unregister_service(self, service_id: str) -> None:
@@ -280,6 +427,7 @@ class ServerConnection:
             {"t": protocol.UNREGISTER, "service_id": service_id}
         )
         self._local_services.pop(service_id, None)
+        self._service_definitions.pop(service_id, None)
 
     async def list_services(self, workspace: Optional[str] = None) -> list[dict]:
         return await self._request(
@@ -328,7 +476,9 @@ async def connect_to_server(config: dict[str, Any]) -> ServerConnection:
 
     Optional transport keys: ``shm_store`` (a store instance for the
     same-host fast path, ``"auto"`` to attach the advertised native
-    segment, None to disable) and ``transport_config``."""
+    segment, None to disable), ``transport_config``, and ``reconnect``
+    (auto-reconnect with backoff on an unexpected drop; registered
+    services are re-registered transparently)."""
     url = config["server_url"]
     if url.startswith("http"):
         url = "ws" + url[4:]
@@ -341,5 +491,6 @@ async def connect_to_server(config: dict[str, Any]) -> ServerConnection:
         shm_store=config.get("shm_store", "auto"),
         transport_config=config.get("transport_config"),
         protocols=config.get("protocols"),
+        auto_reconnect=bool(config.get("reconnect", False)),
     )
     return await conn.connect()

@@ -31,6 +31,7 @@ from aiohttp import WSMsgType, web
 from bioengine_tpu.rpc import protocol
 from bioengine_tpu.rpc.schema import extract_schema
 from bioengine_tpu.rpc.transport import Codec, RpcStats, TransportConfig
+from bioengine_tpu.testing import faults
 from bioengine_tpu.utils.logger import create_logger
 from bioengine_tpu.utils.tasks import spawn_supervised
 
@@ -553,6 +554,8 @@ class RpcServer:
         """Encode per the client's negotiated capabilities and send —
         one websocket message per frame (oversized frames go out as a
         chunk sequence). Large payloads encode off-loop."""
+        if faults.ACTIVE:
+            await faults.hit("rpc.server.send", drop=ws.close)
         if codec is None:
             codec = Codec(config=self.transport_config, stats=self.stats)
         for frame in await codec.encode_frames_async(msg):

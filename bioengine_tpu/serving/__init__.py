@@ -2,15 +2,29 @@ from bioengine_tpu.serving.batching import ContinuousBatcher
 from bioengine_tpu.serving.controller import (
     DeploymentHandle,
     DeploymentSpec,
+    RequestOptions,
     ServeController,
+)
+from bioengine_tpu.serving.errors import (
+    ApplicationError,
+    DeadlineExceeded,
+    NoHealthyReplicasError,
+    ReplicaUnavailableError,
+    RetryableTransportError,
 )
 from bioengine_tpu.serving.replica import Replica, ReplicaState
 
 __all__ = [
+    "ApplicationError",
     "ContinuousBatcher",
+    "DeadlineExceeded",
     "DeploymentHandle",
     "DeploymentSpec",
-    "ServeController",
+    "NoHealthyReplicasError",
     "Replica",
     "ReplicaState",
+    "ReplicaUnavailableError",
+    "RequestOptions",
+    "RetryableTransportError",
+    "ServeController",
 ]

@@ -19,15 +19,71 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import os
 import time
 from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from bioengine_tpu.cluster.state import ClusterState
+from bioengine_tpu.rpc.protocol import RemoteError
+from bioengine_tpu.serving.errors import (
+    DeadlineExceeded,
+    FailureKind,
+    NoHealthyReplicasError,
+    ReplicaUnavailableError,
+    RetryableTransportError,
+    classify_exception,
+)
 from bioengine_tpu.serving.remote import RemoteReplica
-from bioengine_tpu.serving.replica import Replica, ReplicaState
+from bioengine_tpu.serving.replica import (
+    ROUTABLE_STATES,
+    Replica,
+    ReplicaState,
+)
+from bioengine_tpu.utils.backoff import full_jitter_delay
 from bioengine_tpu.utils.logger import create_logger
+
+
+@dataclass(frozen=True)
+class RequestOptions:
+    """Per-request envelope for ``DeploymentHandle.call``.
+
+    ``deadline_s`` bounds the WHOLE request (every attempt + backoff);
+    ``timeout_s`` bounds one attempt and is propagated to the serving
+    host so remote work is aborted there too. ``idempotent`` opts the
+    call into transparent failover: transport/placement errors retry
+    on another healthy replica with exponential backoff + full jitter.
+    Non-idempotent calls surface the first transport error exactly
+    once, typed (``RetryableTransportError``) — never silently retried,
+    because the outcome on the dead replica is ambiguous."""
+
+    timeout_s: Optional[float] = None
+    deadline_s: Optional[float] = None
+    idempotent: bool = False
+    max_attempts: int = 4
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 2.0
+
+    @classmethod
+    def from_env(cls) -> "RequestOptions":
+        env = os.environ.get
+        return cls(
+            max_attempts=int(env("BIOENGINE_REQUEST_MAX_ATTEMPTS", "4")),
+            backoff_base_s=float(env("BIOENGINE_REQUEST_BACKOFF_BASE_S", "0.05")),
+            backoff_cap_s=float(env("BIOENGINE_REQUEST_BACKOFF_CAP_S", "2.0")),
+        )
+
+    @classmethod
+    def defaults(cls) -> "RequestOptions":
+        """Env-derived defaults, read once (this sits on the hot path)."""
+        global _DEFAULT_OPTIONS
+        if _DEFAULT_OPTIONS is None:
+            _DEFAULT_OPTIONS = cls.from_env()
+        return _DEFAULT_OPTIONS
+
+
+_DEFAULT_OPTIONS: Optional[RequestOptions] = None
 
 
 @dataclass
@@ -64,21 +120,134 @@ class DeploymentHandle:
     """Client-side handle: route calls to healthy replicas (least-loaded,
     round-robin tie-break). The composition mechanism: entry deployments
     receive handles to their sibling deployments as init kwargs, same as
-    the reference's DeploymentHandle binding (ref apps/builder.py:1474-1508)."""
+    the reference's DeploymentHandle binding (ref apps/builder.py:1474-1508).
 
-    def __init__(self, controller: "ServeController", app_id: str, deployment: str):
+    Fault tolerance: each call runs under a :class:`RequestOptions`
+    envelope (pass ``options=RequestOptions(...)`` per call, or bind
+    defaults with :meth:`with_options`). Transport/placement failures on
+    idempotent calls fail over to another replica; during a restart
+    window the router WAITS (bounded by the deadline) for a healthy
+    replica instead of raising instantly."""
+
+    def __init__(
+        self,
+        controller: "ServeController",
+        app_id: str,
+        deployment: str,
+        options: Optional[RequestOptions] = None,
+    ):
         self._controller = controller
         self.app_id = app_id
         self.deployment = deployment
+        self._options = options
         self._rr = itertools.count()
 
+    def with_options(self, options: RequestOptions) -> "DeploymentHandle":
+        """A sibling handle whose calls default to ``options``."""
+        return DeploymentHandle(
+            self._controller, self.app_id, self.deployment, options
+        )
+
     async def call(self, method: str, *args, **kwargs) -> Any:
-        replica = self._controller._pick_replica(self.app_id, self.deployment)
-        self._controller._queue_depth[(self.app_id, self.deployment)] += 1
-        try:
-            return await replica.call(method, *args, **kwargs)
-        finally:
-            self._controller._queue_depth[(self.app_id, self.deployment)] -= 1
+        # the envelope rides a reserved kwarg, but ONLY when it is an
+        # actual RequestOptions — an app method's own `options` kwarg
+        # passes through untouched
+        options = kwargs.pop("options", None)
+        if options is not None and not isinstance(options, RequestOptions):
+            kwargs["options"] = options
+            options = None
+        options = options or self._options or RequestOptions.defaults()
+
+        deadline = (
+            time.monotonic() + options.deadline_s
+            if options.deadline_s is not None
+            else None
+        )
+        key = (self.app_id, self.deployment)
+        tried: set[str] = set()
+        attempt = 0
+        while True:
+            attempt += 1
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise DeadlineExceeded(
+                    f"deadline exhausted after {attempt - 1} attempt(s) "
+                    f"for {self.app_id}/{self.deployment}.{method}"
+                )
+            replica = await self._controller._pick_replica_wait(
+                self.app_id, self.deployment, avoid=tried, deadline=deadline
+            )
+            # the wait above may have parked through most of the budget
+            # — recompute so the attempt (and the host-side timeout it
+            # propagates) cannot overrun the overall deadline
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise DeadlineExceeded(
+                        f"deadline exhausted while waiting for a replica "
+                        f"of {self.app_id}/{self.deployment}"
+                    )
+            budget = _min_defined(options.timeout_s, remaining)
+            self._controller._queue_depth[key] += 1
+            try:
+                result = await replica.call_bounded(
+                    method, args, kwargs, timeout_s=budget
+                )
+                self._controller._breaker_success(replica)
+                return result
+            except Exception as e:
+                kind = classify_exception(e)
+                if kind is FailureKind.APPLICATION:
+                    raise  # the app ran and failed — never retried
+                # a timeout of the CALLER's own budget says nothing
+                # about replica health — only genuine transport/placement
+                # failures feed the circuit breaker
+                caller_timeout = isinstance(e, asyncio.TimeoutError) or (
+                    isinstance(e, RemoteError)
+                    and e.type_name == "TimeoutError"
+                )
+                if not caller_timeout:
+                    self._controller._breaker_failure(replica, e)
+                tried.add(replica.replica_id)
+                if isinstance(e, DeadlineExceeded):
+                    raise
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    # the overall budget is gone — surface it AS a
+                    # deadline on every path (a non-idempotent attempt
+                    # whose timeout WAS the deadline cut included)
+                    raise DeadlineExceeded(
+                        f"deadline exhausted after {attempt} attempt(s): {e}"
+                    ) from e
+                # a LOCAL ReplicaUnavailableError was raised by the
+                # routability check BEFORE anything was sent — zero
+                # ambiguity, so even non-idempotent calls fail over
+                not_executed = isinstance(
+                    e, ReplicaUnavailableError
+                ) and not isinstance(e, RemoteError)
+                if not options.idempotent and not not_executed:
+                    raise RetryableTransportError(
+                        f"{self.app_id}/{self.deployment}.{method} failed in "
+                        f"transport on {replica.replica_id} (non-idempotent "
+                        f"call, not retried): {e}"
+                    ) from e
+                if attempt >= options.max_attempts:
+                    raise RetryableTransportError(
+                        f"{self.app_id}/{self.deployment}.{method} failed "
+                        f"after {attempt} attempts: {e}"
+                    ) from e
+                # exponential backoff with FULL jitter, clamped to the
+                # remaining deadline budget
+                delay = full_jitter_delay(
+                    attempt - 1, options.backoff_base_s, options.backoff_cap_s
+                )
+                if remaining is not None:
+                    delay = min(delay, max(0.0, remaining))
+                await asyncio.sleep(delay)
+            finally:
+                self._controller._queue_depth[key] -= 1
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
@@ -91,20 +260,43 @@ class DeploymentHandle:
         return invoke
 
 
+def _min_defined(*values: Optional[float]) -> Optional[float]:
+    present = [v for v in values if v is not None]
+    return min(present) if present else None
+
+
 class ServeController:
     def __init__(
         self,
         cluster_state: Optional[ClusterState] = None,
         health_check_period: float = 10.0,
         log_file: Optional[str] = None,
+        breaker_threshold: Optional[int] = None,
+        health_check_concurrency: int = 8,
     ):
         self.cluster_state = cluster_state or ClusterState()
         self.health_check_period = health_check_period
+        self.health_check_concurrency = health_check_concurrency
+        # per-replica circuit breaker: K consecutive transport failures
+        # eject the replica immediately (no waiting for the health tick)
+        self.breaker_threshold = (
+            breaker_threshold
+            if breaker_threshold is not None
+            else int(os.environ.get("BIOENGINE_BREAKER_THRESHOLD", "3"))
+        )
+        # routable-replica wait during restart windows when the request
+        # carries no deadline (read once — this sits on the hot path)
+        self.pick_replica_grace_s = float(
+            os.environ.get("BIOENGINE_PICK_REPLICA_WAIT_S", "10")
+        )
         self.apps: dict[str, AppDeployment] = {}
         self.logger = create_logger("serving", log_file=log_file)
         self._health_task: Optional[asyncio.Task] = None
+        self._wake_health = asyncio.Event()   # breaker trips ring this
         self._queue_depth: dict[tuple[str, str], int] = defaultdict(int)
         self._rr_counters: dict[tuple[str, str], itertools.count] = {}
+        self._breaker_counts: dict[str, int] = {}
+        self._replicas_changed = asyncio.Event()
         self._rpc_server = None            # set by attach_rpc (multi-host)
         self._router_admins: list[str] = []
 
@@ -140,17 +332,42 @@ class ServeController:
             return await handle.call(method, *(args or []), **(kwargs or {}))
 
         def register_host(
-            host_id, service_id, topology, worker_tag=None, context=None
+            host_id,
+            service_id,
+            topology,
+            worker_tag=None,
+            replicas=None,
+            context=None,
         ):
             check_permissions(context, self._router_admins, "register_host")
             self.cluster_state.register_host(
                 host_id, service_id, topology, worker_tag
             )
+            # reconcile a REJOINING host's still-warm replicas: each one
+            # the controller still routes to this host is re-adopted
+            # (service id + chip lease restored); anything already
+            # re-placed elsewhere is returned for the host to discard
+            drop_replicas = []
+            for info in replicas or []:
+                if not self._readopt_replica(host_id, service_id, info):
+                    drop_replicas.append(info.get("replica_id"))
             self.logger.info(
                 f"host '{host_id}' joined with "
                 f"{topology.get('n_chips', 0)} chips ({service_id})"
+                + (
+                    f"; re-adopted {len(replicas or []) - len(drop_replicas)}"
+                    f"/{len(replicas)} warm replicas"
+                    if replicas
+                    else ""
+                )
             )
-            return {"host_id": host_id, "registered": True}
+            if replicas:
+                self._replicas_changed.set()
+            return {
+                "host_id": host_id,
+                "registered": True,
+                "drop_replicas": drop_replicas,
+            }
 
         def deregister_host(host_id, context=None):
             check_permissions(context, self._router_admins, "deregister_host")
@@ -295,7 +512,51 @@ class ServeController:
             raise
         app.replicas[spec.name].append(replica)
         self.cluster_state.remove_pending(f"{app.app_id}/{spec.name}")
+        self._replicas_changed.set()  # wake requests parked in _pick_replica_wait
         return replica
+
+    def _readopt_replica(
+        self, host_id: str, service_id: str, info: dict
+    ) -> bool:
+        """Reconcile one still-warm replica reported by a REJOINING
+        host: if the controller still routes that replica_id to this
+        host, restore its service binding + chip lease and (when the
+        host reports it routable) clear the UNHEALTHY verdict the
+        disconnect earned it. Returns False when the replica was
+        already re-placed elsewhere — the host must discard its copy."""
+        app = self.apps.get(info.get("app_id", ""))
+        if app is None or app.status == "STOPPED":
+            return False
+        for r in app.replicas.get(info.get("deployment", ""), []):
+            if r.replica_id != info.get("replica_id"):
+                continue
+            if not getattr(r, "is_remote", False) or r.host_id != host_id:
+                return False
+            try:
+                reported = ReplicaState(info.get("state", ""))
+            except ValueError:
+                reported = ReplicaState.UNHEALTHY
+            if reported not in ROUTABLE_STATES + (ReplicaState.INITIALIZING,):
+                return False
+            try:
+                self.cluster_state.host_adopt_chips(
+                    host_id, r.replica_id, list(r.device_ids)
+                )
+            except Exception as e:  # noqa: BLE001 — lease conflict = don't adopt
+                self.logger.warning(
+                    f"cannot re-adopt {r.replica_id} on '{host_id}': {e}"
+                )
+                return False
+            r.host_service_id = service_id
+            r.state = reported
+            r.last_error = None
+            self._breaker_counts.pop(r.replica_id, None)
+            self.logger.info(
+                f"re-adopted warm replica {r.replica_id} on rejoined "
+                f"host '{host_id}' (state={reported})"
+            )
+            return True
+        return False
 
     def _make_remote_replica(
         self, app: AppDeployment, spec: DeploymentSpec
@@ -325,20 +586,42 @@ class ServeController:
         )
         return replica
 
-    async def undeploy(self, app_id: str) -> None:
+    async def undeploy(
+        self, app_id: str, drain_timeout_s: Optional[float] = None
+    ) -> None:
         app = self.apps.pop(app_id, None)
         if app is None:
             return
-        for replicas in app.replicas.values():
-            for r in replicas:
-                await r.stop()
-                self.cluster_state.mark_replica_dead(r.replica_id)
+        # drain-then-stop every replica concurrently: new calls are
+        # rejected the moment states flip to DRAINING, in-flight
+        # requests get up to drain_timeout_s to finish
+        await asyncio.gather(
+            *(
+                self._retire_replica(r, drain_timeout_s)
+                for replicas in app.replicas.values()
+                for r in replicas
+            )
+        )
         app.status = "STOPPED"
         self.logger.info(f"app '{app_id}' undeployed")
 
+    async def _retire_replica(
+        self, replica, drain_timeout_s: Optional[float] = None
+    ) -> None:
+        try:
+            await replica.stop(drain_timeout_s)
+        finally:
+            self.cluster_state.mark_replica_dead(replica.replica_id)
+            self._breaker_counts.pop(replica.replica_id, None)
+
     # ---- request routing ----------------------------------------------------
 
-    def get_handle(self, app_id: str, deployment: Optional[str] = None) -> DeploymentHandle:
+    def get_handle(
+        self,
+        app_id: str,
+        deployment: Optional[str] = None,
+        options: Optional[RequestOptions] = None,
+    ) -> DeploymentHandle:
         app = self.apps.get(app_id)
         if app is None:
             raise KeyError(f"app '{app_id}' not deployed")
@@ -347,19 +630,28 @@ class ServeController:
         if deployment not in app.specs:
             raise KeyError(f"app '{app_id}' has no deployment '{deployment}'")
         self._queue_depth.setdefault((app_id, deployment), 0)
-        return DeploymentHandle(self, app_id, deployment)
+        return DeploymentHandle(self, app_id, deployment, options)
 
-    def _pick_replica(self, app_id: str, deployment: str) -> Replica:
+    def _pick_replica(
+        self, app_id: str, deployment: str, avoid: Optional[set] = None
+    ) -> Replica:
+        """Least-loaded routable replica, round-robin tie-break.
+        ``avoid`` holds replica_ids that already failed THIS request —
+        preferred against, but used as a last resort (the replica may
+        have recovered and being wrong just costs one more retry)."""
         app = self.apps.get(app_id)
         if app is None:
             raise KeyError(f"app '{app_id}' not deployed")
         healthy = [
             r
             for r in app.replicas.get(deployment, [])
-            if r.state in (ReplicaState.HEALTHY, ReplicaState.TESTING)
+            if r.state in ROUTABLE_STATES
         ]
+        if avoid:
+            preferred = [r for r in healthy if r.replica_id not in avoid]
+            healthy = preferred or healthy
         if not healthy:
-            raise RuntimeError(
+            raise NoHealthyReplicasError(
                 f"no healthy replicas for {app_id}/{deployment}"
             )
         min_load = min(r.load for r in healthy)
@@ -369,12 +661,75 @@ class ServeController:
         )
         return candidates[next(rr) % len(candidates)]
 
+    async def _pick_replica_wait(
+        self,
+        app_id: str,
+        deployment: str,
+        avoid: Optional[set] = None,
+        deadline: Optional[float] = None,
+    ) -> Replica:
+        """Like ``_pick_replica`` but WAITS through a restart window
+        (bounded by the request deadline, or a default grace period)
+        instead of raising instantly — a replica being re-placed after
+        a host death is invisible to callers that can afford to wait."""
+        wait_until = (
+            deadline
+            if deadline is not None
+            else time.monotonic() + self.pick_replica_grace_s
+        )
+        while True:
+            try:
+                return self._pick_replica(app_id, deployment, avoid=avoid)
+            except NoHealthyReplicasError:
+                remaining = wait_until - time.monotonic()
+                if remaining <= 0:
+                    raise
+                self._replicas_changed.clear()
+                try:
+                    # woken early when a replica is (re-)placed
+                    await asyncio.wait_for(
+                        self._replicas_changed.wait(), min(remaining, 0.25)
+                    )
+                except asyncio.TimeoutError:
+                    pass
+
+    # ---- circuit breaker ----------------------------------------------------
+
+    def _breaker_failure(self, replica, exc: Exception) -> None:
+        """Record one transport failure. At ``breaker_threshold``
+        consecutive failures the replica is ejected NOW (marked
+        UNHEALTHY + health loop woken) instead of waiting out the
+        health period."""
+        rid = replica.replica_id
+        n = self._breaker_counts.get(rid, 0) + 1
+        self._breaker_counts[rid] = n
+        if n >= self.breaker_threshold and replica.state in ROUTABLE_STATES:
+            replica.state = ReplicaState.UNHEALTHY
+            replica.last_error = (
+                f"circuit breaker opened after {n} consecutive transport "
+                f"failures (last: {exc})"
+            )
+            self.logger.warning(
+                f"breaker ejected replica {rid} after {n} transport failures"
+            )
+            self._wake_health.set()
+
+    def _breaker_success(self, replica) -> None:
+        self._breaker_counts.pop(replica.replica_id, None)
+
     # ---- health + autoscaling loop ------------------------------------------
 
     async def _health_loop(self) -> None:
         while True:
             try:
-                await asyncio.sleep(self.health_check_period)
+                try:
+                    # a breaker trip wakes the loop immediately
+                    await asyncio.wait_for(
+                        self._wake_health.wait(), self.health_check_period
+                    )
+                except asyncio.TimeoutError:
+                    pass
+                self._wake_health.clear()
                 await self.health_tick()
             except asyncio.CancelledError:
                 return
@@ -382,39 +737,94 @@ class ServeController:
                 self.logger.error(f"health loop error: {e}")
 
     async def health_tick(self) -> None:
-        """One pass: health-check replicas, restart dead ones, autoscale."""
+        """One pass: health-check replicas, restart dead ones, autoscale.
+        Apps are checked concurrently, and replicas within an app are
+        checked concurrently under a per-app bound — one host hitting
+        the 30 s ``replica_health`` timeout must not stall every other
+        app's restart."""
         self._prune_dead_hosts()
-        for app in list(self.apps.values()):
-            any_unhealthy = False
-            for spec_name, spec in app.specs.items():
-                replicas = app.replicas.get(spec_name, [])
-                for r in list(replicas):
-                    state = await r.check_health()
-                    if state == ReplicaState.UNHEALTHY:
-                        any_unhealthy = True
-                        self.logger.warning(
-                            f"restarting unhealthy replica {r.replica_id}"
-                        )
-                        await r.stop()
-                        self.cluster_state.mark_replica_dead(r.replica_id)
-                        replicas.remove(r)
-                        try:
-                            await self._add_replica(app, spec)
-                        except Exception as e:
-                            self.logger.error(
-                                f"replica restart failed for "
-                                f"{app.app_id}/{spec_name}: {e}"
-                            )
-                await self._autoscale(app, spec)
-                alive = [
-                    r
-                    for r in app.replicas.get(spec_name, [])
-                    if r.state in (ReplicaState.HEALTHY, ReplicaState.TESTING,
-                                   ReplicaState.INITIALIZING)
-                ]
-                if not alive:
-                    any_unhealthy = True
-            app.status = "UNHEALTHY" if any_unhealthy else "RUNNING"
+        # DEPLOYING apps are excluded: deploy() is still placing their
+        # replicas, and a concurrent restart/top-up here would race it
+        # into double-placed replicas and double-leased chips
+        apps = [
+            a
+            for a in self.apps.values()
+            if a.status not in ("DEPLOYING", "DEPLOY_FAILED", "STOPPED")
+        ]
+        await asyncio.gather(*(self._health_tick_app(a) for a in apps))
+
+    async def _health_tick_app(self, app: AppDeployment) -> None:
+        any_unhealthy = False
+        sem = asyncio.Semaphore(self.health_check_concurrency)
+
+        async def checked(r):
+            async with sem:
+                try:
+                    return await r.check_health()
+                except Exception as e:  # noqa: BLE001 — a throwing check is unhealthy
+                    self.logger.error(
+                        f"check_health raised for {r.replica_id}: {e}"
+                    )
+                    return ReplicaState.UNHEALTHY
+
+        for spec_name, spec in app.specs.items():
+            replicas = app.replicas.get(spec_name, [])
+            snapshot = list(replicas)
+            states = await asyncio.gather(*(checked(r) for r in snapshot))
+            for r, state in zip(snapshot, states):
+                if state != ReplicaState.UNHEALTHY:
+                    continue
+                any_unhealthy = True
+                self.logger.warning(
+                    f"restarting unhealthy replica {r.replica_id}"
+                )
+                await r.stop()
+                self.cluster_state.mark_replica_dead(r.replica_id)
+                self._breaker_counts.pop(r.replica_id, None)
+                if r in replicas:
+                    replicas.remove(r)
+                try:
+                    await self._add_replica(app, spec)
+                    self._replicas_changed.set()
+                except Exception as e:
+                    self.logger.error(
+                        f"replica restart failed for "
+                        f"{app.app_id}/{spec_name}: {e}"
+                    )
+            # top up a deployment that fell below min_replicas (e.g. a
+            # restart failed for lack of capacity on an earlier tick, or
+            # a rejoining host was told to drop an already-re-placed
+            # replica) — without this the app would stay degraded even
+            # after capacity returns
+            while (
+                len(
+                    [
+                        r
+                        for r in app.replicas.get(spec_name, [])
+                        if r.state
+                        in ROUTABLE_STATES + (ReplicaState.INITIALIZING,)
+                    ]
+                )
+                < spec.min_replicas
+            ):
+                try:
+                    await self._add_replica(app, spec)
+                    self._replicas_changed.set()
+                except Exception as e:
+                    self.logger.warning(
+                        f"top-up blocked for {app.app_id}/{spec_name}: {e}"
+                    )
+                    break
+            await self._autoscale(app, spec)
+            alive = [
+                r
+                for r in app.replicas.get(spec_name, [])
+                if r.state in (ReplicaState.HEALTHY, ReplicaState.TESTING,
+                               ReplicaState.INITIALIZING)
+            ]
+            if not alive:
+                any_unhealthy = True
+        app.status = "UNHEALTHY" if any_unhealthy else "RUNNING"
 
     def _prune_dead_hosts(self) -> None:
         """A host whose RPC service vanished (websocket closed) is dead:
@@ -475,9 +885,8 @@ class ServeController:
                     f"autoscale DOWN {app.app_id}/{spec.name} "
                     f"({victim.replica_id})"
                 )
-                await victim.stop()
-                self.cluster_state.mark_replica_dead(victim.replica_id)
                 app.replicas[spec.name].remove(victim)
+                await self._retire_replica(victim)
 
     # ---- status -------------------------------------------------------------
 

@@ -1,0 +1,115 @@
+"""Typed error taxonomy for the fault-tolerant request path.
+
+The request path must distinguish three failure classes, because each
+gets different treatment by ``DeploymentHandle.call``:
+
+- **Transport / placement** (:class:`RetryableTransportError` and the
+  builtin ``ConnectionError`` family): the call may never have reached
+  the application. Idempotent calls fail over to another healthy
+  replica; non-idempotent calls surface the error exactly once, typed,
+  so the caller KNOWS the outcome is ambiguous.
+- **Application** (anything the deployment instance raised, locally or
+  as a :class:`~bioengine_tpu.rpc.protocol.RemoteError`): the call ran
+  and failed deterministically. Never retried — retrying would double
+  side effects and hide real bugs.
+- **Deadline** (:class:`DeadlineExceeded`): the request's time budget
+  ran out. A per-attempt timeout is ambiguous like a transport error
+  (retry only if idempotent and budget remains); an exhausted overall
+  deadline is terminal.
+
+Remote classification rides the wire via exception TYPE NAMES: the RPC
+plane packs ``type(exc).__name__`` into ``RemoteError.type_name``
+(rpc/protocol.py ``_pack_exception``), so a worker host raising
+``ReplicaUnavailableError`` is recognized as retryable on the
+controller side without any new wire fields.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+
+from bioengine_tpu.rpc.protocol import RemoteError
+
+
+class RetryableTransportError(RuntimeError):
+    """The call failed before/while crossing the transport or placement
+    layer — the application may never have seen it. Safe to retry when
+    the call is idempotent."""
+
+
+class ReplicaUnavailableError(RetryableTransportError):
+    """The targeted replica cannot take new calls (not healthy, gone
+    from its host, or draining). A placement error: another replica may
+    serve the same call."""
+
+
+class NoHealthyReplicasError(RetryableTransportError):
+    """No routable replica exists right now (restart window). Retryable
+    because the health loop / provisioner may re-place one."""
+
+
+class ApplicationError(Exception):
+    """The deployment instance itself raised — deterministic, never
+    retried. (Classification treats any unrecognized exception as
+    application-level; this type exists for callers that want to raise
+    an explicitly-final error through the retry layer.)"""
+
+
+class DeadlineExceeded(asyncio.TimeoutError):
+    """The request's overall deadline expired (including any failover
+    backoff)."""
+
+
+class FailureKind(str, enum.Enum):
+    TRANSPORT = "transport"
+    APPLICATION = "application"
+    DEADLINE = "deadline"
+
+
+# Remote exception type names that indicate the failure happened in the
+# transport/placement layer on the far side, not in application code.
+_RETRYABLE_REMOTE_TYPES = frozenset(
+    {
+        "ConnectionError",
+        "ConnectionResetError",
+        "ConnectionAbortedError",
+        "ConnectionRefusedError",
+        "ConnectionLost",       # rpc.client: ws dropped with call in flight
+        "BrokenPipeError",
+        "FaultInjected",
+        "RetryableTransportError",
+        "ReplicaUnavailableError",
+        "NoHealthyReplicasError",
+    }
+)
+
+
+def classify_exception(exc: BaseException) -> FailureKind:
+    """Map an exception from a replica call to its failure class."""
+    if isinstance(exc, DeadlineExceeded):
+        return FailureKind.DEADLINE
+    if isinstance(exc, ApplicationError):
+        return FailureKind.APPLICATION
+    if isinstance(exc, (RetryableTransportError, ConnectionError)):
+        return FailureKind.TRANSPORT
+    if isinstance(exc, (asyncio.TimeoutError, TimeoutError)):
+        # a per-attempt timeout: outcome ambiguous, same retry rules as
+        # a transport error (idempotent-only)
+        return FailureKind.TRANSPORT
+    if isinstance(exc, RemoteError):
+        if exc.type_name in _RETRYABLE_REMOTE_TYPES:
+            return FailureKind.TRANSPORT
+        if exc.type_name == "TimeoutError":
+            return FailureKind.TRANSPORT  # remote per-attempt timeout
+        if exc.type_name == "KeyError" and "no replica" in str(exc):
+            # the host dropped/never had the replica — placement moved
+            return FailureKind.TRANSPORT
+        return FailureKind.APPLICATION
+    if isinstance(exc, OSError):
+        return FailureKind.TRANSPORT
+    return FailureKind.APPLICATION
+
+
+def is_retryable(exc: BaseException) -> bool:
+    return classify_exception(exc) is FailureKind.TRANSPORT

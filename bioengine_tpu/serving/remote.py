@@ -8,19 +8,30 @@ bioengine/cluster/slurm_workers.py:153-296). The instance is built ON
 the host from a shipped artifact payload (manifest + sources + kwargs —
 never pickled closures), so hosts need no shared filesystem.
 
-Host death is detected two ways: the RPC server drops a host's service
-the moment its websocket closes (so calls raise), and ``check_health``
-maps any transport error to UNHEALTHY — which makes the controller's
-normal restart path re-place the replica on another host (or locally).
+Host death is detected three ways: the RPC server drops a host's
+service the moment its websocket closes (so in-flight calls raise
+``ConnectionError`` instead of timing out), ``check_health`` maps any
+transport error to UNHEALTHY, and the controller's per-replica circuit
+breaker ejects a replica after K consecutive transport failures
+without waiting for the next health tick. A host that RECONNECTS
+before its replicas are re-placed re-adopts them via
+``serve-router.register_host`` reconciliation (warm weights and
+compiled programs survive the blip).
 """
 
 from __future__ import annotations
 
+import asyncio
 import time
 import uuid
 from typing import Any, Callable, Optional
 
-from bioengine_tpu.serving.replica import ReplicaState
+from bioengine_tpu.serving.errors import ReplicaUnavailableError
+from bioengine_tpu.serving.replica import (
+    DEFAULT_DRAIN_TIMEOUT_S,
+    ROUTABLE_STATES,
+    ReplicaState,
+)
 
 
 class RemoteReplica:
@@ -37,6 +48,7 @@ class RemoteReplica:
         device_ids: Optional[list[int]] = None,
         max_ongoing_requests: int = 10,
         log_sink: Optional[Callable[[str, str], None]] = None,
+        drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S,
     ):
         self.app_id = app_id
         self.deployment_name = deployment_name
@@ -45,6 +57,7 @@ class RemoteReplica:
         self.host_service_id = host_service_id
         self.device_ids = device_ids or []
         self.max_ongoing_requests = max_ongoing_requests
+        self.drain_timeout_s = drain_timeout_s
         self.state = ReplicaState.STARTING
         self.started_at = time.time()
         self.last_error: Optional[str] = None
@@ -52,6 +65,8 @@ class RemoteReplica:
         self._call_host = call_host
         self._ongoing = 0
         self._total_requests = 0
+        self._idle_event = asyncio.Event()
+        self._idle_event.set()
         self._log_sink = log_sink
 
     def _log(self, line: str) -> None:
@@ -80,11 +95,13 @@ class RemoteReplica:
             raise
 
     async def check_health(self) -> ReplicaState:
-        if self.state in (ReplicaState.STOPPED, ReplicaState.UNHEALTHY):
+        if self.state in (
+            ReplicaState.STOPPED,
+            ReplicaState.UNHEALTHY,
+            ReplicaState.DRAINING,
+        ):
             return self.state
         try:
-            import asyncio
-
             result = await asyncio.wait_for(
                 self._call_host(
                     self.host_service_id, "replica_health", self.replica_id
@@ -102,9 +119,47 @@ class RemoteReplica:
             self._log(self.last_error)
         return self.state
 
-    async def stop(self) -> None:
-        import asyncio
+    async def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Stop routing new calls here; ask the host to finish what's
+        in flight (bounded). Host-side drain failures are tolerated —
+        a dead host has trivially drained."""
+        if self.state in ROUTABLE_STATES + (ReplicaState.INITIALIZING,):
+            self.state = ReplicaState.DRAINING
+            self._log(f"draining ({self._ongoing} in-flight)")
+        timeout = self.drain_timeout_s if timeout_s is None else timeout_s
+        started = time.monotonic()
+        try:
+            await asyncio.wait_for(
+                self._call_host(
+                    self.host_service_id,
+                    "drain_replica",
+                    self.replica_id,
+                    timeout,
+                ),
+                timeout=timeout + 5.0,
+            )
+        except Exception:
+            pass
+        # calls routed through THIS object (the only routing path) must
+        # also settle before the replica is torn down — on whatever is
+        # LEFT of the one drain budget, not a second full helping
+        if self._ongoing == 0:
+            return True
+        remaining = max(0.0, timeout - (time.monotonic() - started))
+        try:
+            await asyncio.wait_for(self._idle_event.wait(), remaining)
+            return True
+        except asyncio.TimeoutError:
+            self._log(f"drain timed out ({self._ongoing} stranded)")
+            return False
 
+    async def stop(self, drain_timeout_s: Optional[float] = None) -> None:
+        if self.state in (
+            ReplicaState.HEALTHY,
+            ReplicaState.TESTING,
+            ReplicaState.DRAINING,
+        ):
+            await self.drain(drain_timeout_s)
         self.state = ReplicaState.STOPPED
         try:
             await asyncio.wait_for(
@@ -120,23 +175,52 @@ class RemoteReplica:
     # ---- request path -------------------------------------------------------
 
     async def call(self, method: str, *args, **kwargs) -> Any:
-        if self.state not in (ReplicaState.HEALTHY, ReplicaState.TESTING):
-            raise RuntimeError(
+        return await self.call_bounded(method, args, kwargs)
+
+    async def call_bounded(
+        self,
+        method: str,
+        args: tuple = (),
+        kwargs: Optional[dict] = None,
+        timeout_s: Optional[float] = None,
+    ) -> Any:
+        """Route one call to the host, propagating the remaining time
+        budget so the HOST aborts the work too (not just the caller's
+        await) when the deadline passes."""
+        if self.state not in ROUTABLE_STATES:
+            raise ReplicaUnavailableError(
                 f"replica {self.replica_id} not healthy ({self.state})"
             )
         self._ongoing += 1
+        self._idle_event.clear()
         self._total_requests += 1
         try:
+            extra: dict = {}
+            if timeout_s is not None:
+                # host enforces timeout_s around the instance call; the
+                # transport timeout gets slack so the host's (typed)
+                # TimeoutError wins the race over a bare client timeout
+                extra = {"timeout_s": timeout_s, "rpc_timeout": timeout_s + 5.0}
             return await self._call_host(
                 self.host_service_id,
                 "replica_call",
                 self.replica_id,
                 method,
                 list(args),
-                kwargs,
+                kwargs or {},
+                **extra,
             )
+        except KeyError as e:
+            # a raw KeyError here is the ROUTER's (host service gone
+            # from the registry, i.e. the websocket dropped) — app
+            # exceptions always arrive wrapped as RemoteError
+            raise ReplicaUnavailableError(
+                f"host '{self.host_id}' service vanished: {e}"
+            ) from e
         finally:
             self._ongoing -= 1
+            if self._ongoing == 0:
+                self._idle_event.set()
 
     @property
     def load(self) -> float:

@@ -20,12 +20,18 @@ from __future__ import annotations
 
 import asyncio
 import enum
+import os
 import time
 import traceback
 import uuid
 from typing import Any, Callable, Optional
 
+from bioengine_tpu.serving.errors import ReplicaUnavailableError
 from bioengine_tpu.utils.logger import create_logger
+
+DEFAULT_DRAIN_TIMEOUT_S = float(
+    os.environ.get("BIOENGINE_DRAIN_TIMEOUT_S", "30")
+)
 
 
 class ReplicaState(str, enum.Enum):
@@ -34,7 +40,11 @@ class ReplicaState(str, enum.Enum):
     TESTING = "TESTING"
     HEALTHY = "HEALTHY"
     UNHEALTHY = "UNHEALTHY"
+    DRAINING = "DRAINING"          # no new calls; in-flight may finish
     STOPPED = "STOPPED"
+
+# states a DeploymentHandle may route new calls to
+ROUTABLE_STATES = (ReplicaState.HEALTHY, ReplicaState.TESTING)
 
 
 class Replica:
@@ -46,6 +56,7 @@ class Replica:
         device_ids: Optional[list[int]] = None,
         max_ongoing_requests: int = 10,
         log_sink: Optional[Callable[[str, str], None]] = None,
+        drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S,
     ):
         self.app_id = app_id
         self.deployment_name = deployment_name
@@ -53,10 +64,13 @@ class Replica:
         self.device_ids = device_ids or []
         self.state = ReplicaState.STARTING
         self.max_ongoing_requests = max_ongoing_requests
+        self.drain_timeout_s = drain_timeout_s
         self._instance_factory = instance_factory
         self.instance: Any = None
         self._semaphore = asyncio.Semaphore(max_ongoing_requests)
         self._ongoing = 0
+        self._idle_event = asyncio.Event()
+        self._idle_event.set()
         self._total_requests = 0
         self._test_task: Optional[asyncio.Task] = None
         self._test_error: Optional[str] = None
@@ -111,7 +125,11 @@ class Replica:
 
     async def check_health(self) -> ReplicaState:
         """init done -> test passed -> user check_health."""
-        if self.state in (ReplicaState.STOPPED, ReplicaState.UNHEALTHY):
+        if self.state in (
+            ReplicaState.STOPPED,
+            ReplicaState.UNHEALTHY,
+            ReplicaState.DRAINING,
+        ):
             return self.state
         if not self._init_done:
             return self.state
@@ -129,7 +147,39 @@ class Replica:
                 self._log(f"user check_health failed: {e}")
         return self.state
 
-    async def stop(self) -> None:
+    async def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Reject new calls, let in-flight requests finish (bounded).
+        Returns True when the replica is idle, False on timeout with
+        requests still running (the caller stops it anyway)."""
+        if self.state in (
+            ReplicaState.HEALTHY,
+            ReplicaState.TESTING,
+            ReplicaState.INITIALIZING,
+        ):
+            self.state = ReplicaState.DRAINING
+            self._log(f"draining ({self._ongoing} in-flight)")
+        if self._ongoing == 0:
+            return True
+        timeout = self.drain_timeout_s if timeout_s is None else timeout_s
+        try:
+            await asyncio.wait_for(self._idle_event.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            self._log(
+                f"drain timed out after {timeout}s "
+                f"({self._ongoing} requests stranded)"
+            )
+            return False
+
+    async def stop(self, drain_timeout_s: Optional[float] = None) -> None:
+        # graceful path: a routable replica drains before it stops, so
+        # undeploy/autoscale-down never strand in-flight requests
+        if self.state in (
+            ReplicaState.HEALTHY,
+            ReplicaState.TESTING,
+            ReplicaState.DRAINING,
+        ):
+            await self.drain(drain_timeout_s)
         self.state = ReplicaState.STOPPED
         if self._test_task:
             self._test_task.cancel()
@@ -151,8 +201,8 @@ class Replica:
         # TESTING is routable: init completed, the one-shot background
         # test is still running — same window in which the reference's
         # Serve replicas already accept handle calls (ref builder.py:739-811)
-        if self.state not in (ReplicaState.HEALTHY, ReplicaState.TESTING):
-            raise RuntimeError(
+        if self.state not in ROUTABLE_STATES:
+            raise ReplicaUnavailableError(
                 f"replica {self.replica_id} not healthy ({self.state})"
             )
         fn = getattr(self.instance, method, None)
@@ -161,12 +211,38 @@ class Replica:
                 f"{self.deployment_name} has no method '{method}'"
             )
         async with self._semaphore:
+            # re-check after the (possibly long) semaphore wait: a drain
+            # or stop that happened while this call was parked must not
+            # let it execute against a torn-down instance — the typed
+            # rejection makes the router fail it over instead
+            if self.state not in ROUTABLE_STATES:
+                raise ReplicaUnavailableError(
+                    f"replica {self.replica_id} not healthy ({self.state})"
+                )
             self._ongoing += 1
+            self._idle_event.clear()
             self._total_requests += 1
             try:
                 return await _maybe_await(fn(*args, **kwargs))
             finally:
                 self._ongoing -= 1
+                if self._ongoing == 0:
+                    self._idle_event.set()
+
+    async def call_bounded(
+        self,
+        method: str,
+        args: tuple = (),
+        kwargs: Optional[dict] = None,
+        timeout_s: Optional[float] = None,
+    ) -> Any:
+        """``call`` with a per-attempt time budget (the request path's
+        entry point — a kwarg-free envelope so app methods may use any
+        parameter names)."""
+        coro = self.call(method, *args, **(kwargs or {}))
+        if timeout_s is None:
+            return await coro
+        return await asyncio.wait_for(coro, timeout_s)
 
     @property
     def load(self) -> float:

@@ -1,0 +1,1 @@
+"""Test-only runtime helpers (deterministic fault injection)."""

@@ -1,0 +1,143 @@
+"""Deterministic fault injection for the request path.
+
+Named fault points are compiled into the hot paths of the RPC client,
+the RPC server, and the worker host. Each call site is guarded by the
+module-level ``ACTIVE`` flag, so a production process with no faults
+configured pays one global read per pass — no dict lookups, no
+coroutine scheduling.
+
+A fault is addressed by its point name and triggers on a deterministic
+hit window: the ``nth`` hit (1-based) through ``nth + count - 1``.
+That makes chaos tests reproducible — "drop the connection on the 3rd
+replica_call" behaves identically on every run, unlike SIGKILL-based
+chaos whose timing races the event loop.
+
+Configuration is programmatic (:func:`configure`, same-process tests)
+or via the ``BIOENGINE_FAULTS`` environment variable for subprocesses
+(worker hosts spawned by tests)::
+
+    BIOENGINE_FAULTS="host.replica_call=drop:3;rpc.client.send=raise:1:2"
+
+i.e. ``;``-separated ``point=action[:nth[:count[:delay_s]]]`` entries.
+
+Actions:
+
+- ``raise`` — raise :class:`FaultInjected` (a ``ConnectionError``
+  subclass, so the serving layer classifies it as transport).
+- ``delay`` — ``await asyncio.sleep(delay_s)`` then proceed.
+- ``drop`` — invoke the call site's ``drop`` callback (each site knows
+  how to sever its own connection), then raise :class:`FaultInjected`.
+
+Registered fault points:
+
+==========================  ================================================
+``rpc.client.send``         every outbound client frame (ServerConnection)
+``rpc.server.send``         every outbound server frame (per websocket)
+``host.replica_call``       worker host serving a routed replica call
+``host.start_replica``      worker host building a shipped replica payload
+==========================  ================================================
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Optional
+
+ACTIVE = False
+
+_specs: dict[str, "FaultSpec"] = {}
+_hits: dict[str, int] = {}
+
+
+class FaultInjected(ConnectionError):
+    """Raised by a triggered fault point. Subclasses ConnectionError so
+    the request path treats it as a transport failure."""
+
+
+@dataclass
+class FaultSpec:
+    point: str
+    action: str                  # "raise" | "delay" | "drop"
+    nth: int = 1                 # first triggering hit (1-based)
+    count: int = 1 << 30         # hits that trigger, starting at nth
+    delay_s: float = 0.05
+
+
+def configure(
+    point: str,
+    action: str,
+    nth: int = 1,
+    count: int = 1 << 30,
+    delay_s: float = 0.05,
+) -> None:
+    """Arm a fault point. Resets the point's hit counter."""
+    global ACTIVE
+    if action not in ("raise", "delay", "drop"):
+        raise ValueError(f"unknown fault action '{action}'")
+    _specs[point] = FaultSpec(point, action, nth, count, delay_s)
+    _hits[point] = 0
+    ACTIVE = True
+
+
+def clear(point: Optional[str] = None) -> None:
+    """Disarm one point, or everything (also zeroes hit counters)."""
+    global ACTIVE
+    if point is None:
+        _specs.clear()
+        _hits.clear()
+    else:
+        _specs.pop(point, None)
+        _hits.pop(point, None)
+    ACTIVE = bool(_specs)
+
+
+def hits(point: str) -> int:
+    """How many times a point has been passed since it was armed."""
+    return _hits.get(point, 0)
+
+
+async def hit(
+    point: str,
+    drop: Optional[Callable[[], Awaitable[None]]] = None,
+) -> None:
+    """Pass a fault point. Call sites guard with ``if faults.ACTIVE``
+    so this coroutine is never even created in a clean process."""
+    spec = _specs.get(point)
+    if spec is None:
+        return
+    _hits[point] = n = _hits[point] + 1
+    if not (spec.nth <= n < spec.nth + spec.count):
+        return
+    if spec.action == "delay":
+        await asyncio.sleep(spec.delay_s)
+        return
+    if spec.action == "drop" and drop is not None:
+        try:
+            await drop()
+        finally:
+            raise FaultInjected(
+                f"fault '{point}' dropped the connection (hit #{n})"
+            )
+    raise FaultInjected(f"fault '{point}' triggered (hit #{n})")
+
+
+def load_env(env_value: Optional[str] = None) -> None:
+    """Parse ``BIOENGINE_FAULTS`` (subprocess configuration path)."""
+    raw = (
+        env_value
+        if env_value is not None
+        else os.environ.get("BIOENGINE_FAULTS", "")
+    )
+    for entry in filter(None, (e.strip() for e in raw.split(";"))):
+        point, _, rest = entry.partition("=")
+        parts = rest.split(":")
+        action = parts[0]
+        nth = int(parts[1]) if len(parts) > 1 else 1
+        count = int(parts[2]) if len(parts) > 2 else 1 << 30
+        delay_s = float(parts[3]) if len(parts) > 3 else 0.05
+        configure(point.strip(), action, nth=nth, count=count, delay_s=delay_s)
+
+
+load_env()

@@ -21,6 +21,13 @@ Liveness is structural: when this process dies its websocket closes, the
 RPC server drops the host service, and the controller's health loop
 marks the host dead and re-places its replicas elsewhere.
 
+A CONNECTION drop is not a process death: the client auto-reconnects
+with backoff and this host REJOINS the controller — re-registering its
+service and announcing its still-warm replicas so the controller can
+reconcile (re-adopt whatever it has not yet re-placed). Downloaded
+weights and compiled programs survive a control-plane blip instead of
+being discarded with the process.
+
 Run: ``python -m bioengine_tpu.worker_host --server-url ws://head:PORT/ws
 --token <admin-token>`` (this is exactly what the provisioner's sbatch
 script execs, cluster/provisioner.py).
@@ -40,6 +47,7 @@ from pathlib import Path
 from typing import Any, Optional
 
 from bioengine_tpu.rpc.client import ServerConnection, connect_to_server
+from bioengine_tpu.testing import faults
 from bioengine_tpu.utils.logger import create_logger
 
 
@@ -85,6 +93,7 @@ class WorkerHost:
         workspace_dir: str | Path | None = None,
         worker_tag: Optional[str] = None,
         log_file: Optional[str] = "off",
+        rejoin: bool = True,
     ):
         self.server_url = server_url
         self.token = token
@@ -98,7 +107,9 @@ class WorkerHost:
         self.connection: Optional[ServerConnection] = None
         self.replicas: dict[str, Any] = {}
         self.service_id: Optional[str] = None
+        self.rejoin = rejoin
         self._stop_event = asyncio.Event()
+        self._conn_lost = asyncio.Event()
 
     # ---- lifecycle ----------------------------------------------------------
 
@@ -107,8 +118,17 @@ class WorkerHost:
 
         self.topology = detect_topology()
         self.connection = await connect_to_server(
-            {"server_url": self.server_url, "token": self.token}
+            {
+                "server_url": self.server_url,
+                "token": self.token,
+                "reconnect": self.rejoin,
+            }
         )
+        # connection-lost callback wakes serve_forever IMMEDIATELY (no
+        # polling); after the client re-establishes and re-registers the
+        # host service, _rejoin_cluster reconciles warm replicas
+        self.connection.on_disconnect.append(self._on_connection_lost)
+        self.connection.on_reconnect.append(self._rejoin_cluster)
         result = await self.connection.register_service(
             {
                 "id": f"bioengine-host-{self.host_id}",
@@ -119,40 +139,96 @@ class WorkerHost:
                 "start_replica": self.start_replica,
                 "replica_call": self.replica_call,
                 "replica_health": self.replica_health,
+                "drain_replica": self.drain_replica,
                 "stop_replica": self.stop_replica,
                 "run_code": self.run_code,
                 "shutdown": self.shutdown,
             }
         )
         self.service_id = result["id"]
-        # NB: positional — kwargs named service_id/method would collide
-        # with ServerConnection.call's own parameters
-        joined = await self.connection.call(
-            "serve-router",
-            "register_host",
-            self.host_id,
-            self.service_id,
-            self.topology.as_dict(),
-            self.worker_tag,
-        )
+        joined = await self._register_host()
         self.logger.info(
             f"joined cluster as '{self.host_id}' "
             f"({self.topology.n_chips} chips): {joined}"
         )
         return joined
 
+    async def _register_host(self) -> dict:
+        # NB: positional — kwargs named service_id/method would collide
+        # with ServerConnection.call's own parameters
+        return await self.connection.call(
+            "serve-router",
+            "register_host",
+            self.host_id,
+            self.service_id,
+            self.topology.as_dict(),
+            self.worker_tag,
+            self._replica_inventory(),
+        )
+
+    def _replica_inventory(self) -> list[dict]:
+        return [
+            {
+                "replica_id": rid,
+                "app_id": r.app_id,
+                "deployment": r.deployment_name,
+                "state": r.state.value,
+                "device_ids": list(r.device_ids),
+            }
+            for rid, r in self.replicas.items()
+        ]
+
+    def _on_connection_lost(self) -> None:
+        self._conn_lost.set()
+
+    async def _rejoin_cluster(self) -> None:
+        """After the RPC client re-established + re-registered our
+        service: announce ourselves to the controller again, with the
+        still-warm replica inventory. The controller re-adopts what it
+        has not yet re-placed and tells us to drop the rest."""
+        joined = await self._register_host()
+        dropped = joined.get("drop_replicas") or []
+        for rid in dropped:
+            self.logger.info(
+                f"controller re-placed replica {rid} while we were away; "
+                f"discarding the local copy"
+            )
+            await self.stop_replica(rid)
+        self.logger.info(
+            f"rejoined cluster as '{self.host_id}' "
+            f"(kept {len(self.replicas)} warm replicas, "
+            f"dropped {len(dropped)})"
+        )
+
     async def serve_forever(self) -> None:
-        """Block until shutdown or the control-plane connection drops
-        (a supervisor/provisioner restart is the recovery path, like a
-        Ray worker losing its GCS connection)."""
+        """Block until shutdown. A dropped control-plane connection
+        wakes this loop immediately (connection-lost callback, not a
+        poll): with ``rejoin`` enabled the RPC client heals the session
+        in the background and we keep serving warm replicas; without it
+        we exit so a supervisor/provisioner can restart us."""
         while not self._stop_event.is_set():
-            if self.connection is None or not self.connection.connected:
-                self.logger.warning("control-plane connection lost; exiting")
-                return
+            stop_w = asyncio.ensure_future(self._stop_event.wait())
+            lost_w = asyncio.ensure_future(self._conn_lost.wait())
             try:
-                await asyncio.wait_for(self._stop_event.wait(), timeout=2.0)
-            except asyncio.TimeoutError:
-                pass
+                await asyncio.wait(
+                    {stop_w, lost_w}, return_when=asyncio.FIRST_COMPLETED
+                )
+            finally:
+                for w in (stop_w, lost_w):
+                    if not w.done():
+                        w.cancel()
+            if self._stop_event.is_set():
+                return
+            if self._conn_lost.is_set():
+                self._conn_lost.clear()
+                if not self.rejoin:
+                    self.logger.warning(
+                        "control-plane connection lost; exiting"
+                    )
+                    return
+                self.logger.warning(
+                    "control-plane connection lost; auto-rejoin in progress"
+                )
 
     async def stop(self) -> None:
         for replica_id in list(self.replicas):
@@ -189,6 +265,9 @@ class WorkerHost:
         payload and run the standard replica lifecycle chain."""
         from bioengine_tpu.apps.builder import AppBuilder
         from bioengine_tpu.serving.replica import Replica
+
+        if faults.ACTIVE:
+            await faults.hit("host.start_replica")
 
         app_id = payload["app_id"]
         deployment = payload["deployment"]
@@ -238,11 +317,32 @@ class WorkerHost:
         return replica
 
     async def replica_call(
-        self, replica_id: str, method: str, args: list, kwargs: dict
+        self,
+        replica_id: str,
+        method: str,
+        args: list,
+        kwargs: dict,
+        timeout_s: Optional[float] = None,
     ) -> Any:
-        return await self._get(replica_id).call(
+        """Serve one routed call. ``timeout_s`` is the caller's
+        propagated remaining budget: the work is aborted HERE when it
+        expires, not just abandoned by the controller."""
+        if faults.ACTIVE:
+            await faults.hit(
+                "host.replica_call", drop=self._abort_connection
+            )
+        coro = self._get(replica_id).call(
             method, *(args or []), **(kwargs or {})
         )
+        if timeout_s is None:
+            return await coro
+        return await asyncio.wait_for(coro, timeout_s)
+
+    async def _abort_connection(self) -> None:
+        """Fault-injection hook: sever our control-plane websocket as a
+        network partition would (reconnect/rejoin machinery takes over)."""
+        if self.connection is not None:
+            await self.connection._abort_connection()
 
     async def replica_health(self, replica_id: str) -> dict:
         replica = self._get(replica_id)
@@ -252,6 +352,17 @@ class WorkerHost:
             "state": state.value,
             "last_error": replica.last_error,
         }
+
+    async def drain_replica(
+        self, replica_id: str, timeout_s: Optional[float] = None
+    ) -> dict:
+        """Reject new calls on the replica, wait (bounded) for its
+        in-flight requests to finish."""
+        replica = self.replicas.get(replica_id)
+        if replica is None:
+            return {"replica_id": replica_id, "drained": True, "known": False}
+        drained = await replica.drain(timeout_s)
+        return {"replica_id": replica_id, "drained": drained, "known": True}
 
     async def run_code(
         self,
@@ -349,6 +460,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             host_id=args.host_id,
             workspace_dir=args.workspace_dir,
             worker_tag=args.worker_tag,
+            rejoin=os.environ.get("BIOENGINE_HOST_REJOIN", "1") != "0",
         )
         await host.start()
         try:

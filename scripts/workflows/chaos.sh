@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Chaos soak gate: repeated kill/reconnect cycles under traffic against
+# the in-process multi-host harness (tests/test_chaos.py). The fast
+# deterministic chaos tests run in tier-1; this job runs the slow soak
+# with a higher cycle count and fails on any dropped request, leaked
+# pin/task, or chip-accounting drift.
+#
+# Knobs:
+#   BIOENGINE_CHAOS_CYCLES   kill/rejoin cycles per soak run (default 20 here)
+set -euo pipefail
+
+cd "$(dirname "$0")/../.."
+
+export JAX_PLATFORMS=cpu
+export BIOENGINE_CHAOS_CYCLES="${BIOENGINE_CHAOS_CYCLES:-20}"
+
+echo "== chaos soak (${BIOENGINE_CHAOS_CYCLES} cycles) =="
+timeout -k 10 600 python -m pytest tests/test_chaos.py -m slow -q -rA \
+    -p no:cacheprovider
+
+echo "== fast deterministic chaos tests (tier-1 members, rerun for locality) =="
+timeout -k 10 600 python -m pytest tests/test_chaos.py -m "not slow" -q \
+    -p no:cacheprovider
+
+echo "chaos gate OK"

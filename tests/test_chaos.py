@@ -1,0 +1,1172 @@
+"""Fault-tolerant request path: failover retries, drain, breaker,
+reconnect/rejoin — proven by deterministic chaos.
+
+The chaos harness runs REAL multi-host topologies in-process: an
+RpcServer, a ServeController, and WorkerHost instances all share one
+event loop but speak over real websockets, so killing a host is
+severing its websocket — exactly what a node death looks like to the
+controller — without subprocess spawn costs or SIGKILL timing races.
+Fault points (bioengine_tpu/testing/faults.py) make every failure land
+on a chosen request, every run.
+"""
+
+import asyncio
+import time
+from pathlib import Path
+
+import pytest
+
+from bioengine_tpu.apps.builder import AppBuilder
+from bioengine_tpu.cluster.state import ClusterState
+from bioengine_tpu.cluster.topology import TpuTopology
+from bioengine_tpu.rpc.protocol import RemoteError
+from bioengine_tpu.rpc.server import RpcServer
+from bioengine_tpu.serving import (
+    DeploymentSpec,
+    ReplicaState,
+    RequestOptions,
+    ServeController,
+)
+from bioengine_tpu.serving.errors import (
+    DeadlineExceeded,
+    FailureKind,
+    NoHealthyReplicasError,
+    ReplicaUnavailableError,
+    RetryableTransportError,
+    classify_exception,
+)
+from bioengine_tpu.serving.remote import RemoteReplica
+from bioengine_tpu.testing import faults
+from bioengine_tpu.worker_host import WorkerHost
+
+pytestmark = [pytest.mark.integration, pytest.mark.anyio]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# fault injection layer
+# ---------------------------------------------------------------------------
+
+
+class TestFaults:
+    async def test_deterministic_window(self):
+        faults.configure("p", "raise", nth=3, count=2)
+        for expected_ok in [True, True, False, False, True]:
+            if expected_ok:
+                await faults.hit("p")
+            else:
+                with pytest.raises(faults.FaultInjected):
+                    await faults.hit("p")
+        assert faults.hits("p") == 5
+
+    async def test_drop_invokes_callback_then_raises(self):
+        dropped = []
+
+        async def drop():
+            dropped.append(1)
+
+        faults.configure("p", "drop")
+        with pytest.raises(faults.FaultInjected):
+            await faults.hit("p", drop=drop)
+        assert dropped == [1]
+
+    async def test_delay_action(self):
+        faults.configure("p", "delay", delay_s=0.01)
+        t0 = time.monotonic()
+        await faults.hit("p")
+        assert time.monotonic() - t0 >= 0.01
+
+    async def test_env_parsing(self):
+        faults.load_env("a.b=drop:3;c.d=raise:1:2;e.f=delay:1:5:0.5")
+        assert faults._specs["a.b"].action == "drop"
+        assert faults._specs["a.b"].nth == 3
+        assert faults._specs["c.d"].count == 2
+        assert faults._specs["e.f"].delay_s == 0.5
+        assert faults.ACTIVE
+
+    async def test_inactive_is_free(self):
+        faults.clear()
+        assert not faults.ACTIVE
+        await faults.hit("anything")  # no spec, no counter, no error
+        assert faults.hits("anything") == 0
+
+    async def test_fault_injected_is_transport(self):
+        assert classify_exception(
+            faults.FaultInjected("x")
+        ) is FailureKind.TRANSPORT
+
+
+# ---------------------------------------------------------------------------
+# error taxonomy
+# ---------------------------------------------------------------------------
+
+
+class TestClassification:
+    def test_transport_family(self):
+        for exc in (
+            ConnectionError("x"),
+            ConnectionResetError("x"),
+            RetryableTransportError("x"),
+            ReplicaUnavailableError("x"),
+            NoHealthyReplicasError("x"),
+            asyncio.TimeoutError(),
+            OSError("x"),
+            RemoteError("ConnectionError", "provider gone"),
+            RemoteError("ConnectionLost", "ws dropped mid-call"),
+            RemoteError("FaultInjected", "chaos"),
+            RemoteError("ReplicaUnavailableError", "draining"),
+            RemoteError("TimeoutError", "host-side budget"),
+            RemoteError("KeyError", "\"no replica 'x' on host h\""),
+        ):
+            assert classify_exception(exc) is FailureKind.TRANSPORT, exc
+
+    def test_application_family(self):
+        for exc in (
+            ValueError("bad arg"),
+            RemoteError("ValueError", "bad arg"),
+            RemoteError("KeyError", "'missing-key'"),
+            KeyError("app 'x' not deployed"),
+        ):
+            assert classify_exception(exc) is FailureKind.APPLICATION, exc
+
+    def test_deadline(self):
+        assert classify_exception(DeadlineExceeded()) is FailureKind.DEADLINE
+        # DeadlineExceeded must still satisfy asyncio.TimeoutError waiters
+        assert isinstance(DeadlineExceeded(), asyncio.TimeoutError)
+
+    def test_replica_unavailable_keeps_legacy_message_contract(self):
+        # existing callers match "not healthy" on a RuntimeError
+        assert issubclass(ReplicaUnavailableError, RuntimeError)
+
+
+# ---------------------------------------------------------------------------
+# local retry / drain / breaker / routing (no RPC, fast)
+# ---------------------------------------------------------------------------
+
+
+class FlakyTransportApp:
+    """Raises ConnectionError (transport class) for the first
+    ``fail_first`` calls ACROSS all instances (class-level counter, so
+    a failover lands on a healthy sibling deterministically)."""
+
+    fail_first = 1
+    failures = 0
+
+    def __init__(self):
+        self.calls = 0
+
+    @classmethod
+    def reset(cls, fail_first: int):
+        cls.fail_first = fail_first
+        cls.failures = 0
+
+    async def ping(self, value=0):
+        self.calls += 1
+        if FlakyTransportApp.failures < FlakyTransportApp.fail_first:
+            FlakyTransportApp.failures += 1
+            raise ConnectionError("synthetic transport failure")
+        return {"value": value, "calls": self.calls}
+
+
+@pytest.fixture
+async def controller():
+    c = ServeController(ClusterState(), health_check_period=3600)
+    yield c
+    await c.stop()
+
+
+class TestRetryPolicy:
+    async def test_idempotent_call_fails_over(self, controller):
+        FlakyTransportApp.reset(1)
+        app = await controller.deploy(
+            "rt-app",
+            [
+                DeploymentSpec(
+                    name="e",
+                    instance_factory=FlakyTransportApp,
+                    num_replicas=2,
+                    autoscale=False,
+                )
+            ],
+        )
+        await asyncio.sleep(0.05)
+        handle = controller.get_handle("rt-app")
+        result = await handle.call(
+            "ping", value=7, options=RequestOptions(idempotent=True)
+        )
+        assert result["value"] == 7
+        # exactly one failover: the two replicas saw one call each
+        instances = [r.instance for r in app.replicas["e"]]
+        assert sorted(i.calls for i in instances) == [1, 1]
+
+    async def test_non_idempotent_fails_fast_exactly_once(self, controller):
+        FlakyTransportApp.reset(10)
+        app = await controller.deploy(
+            "rt-app2",
+            [
+                DeploymentSpec(
+                    name="e",
+                    instance_factory=FlakyTransportApp,
+                    num_replicas=2,
+                    autoscale=False,
+                )
+            ],
+        )
+        await asyncio.sleep(0.05)
+        handle = controller.get_handle("rt-app2")
+        with pytest.raises(RetryableTransportError, match="not retried"):
+            await handle.call("ping", options=RequestOptions(idempotent=False))
+        # never silently retried: exactly ONE instance saw ONE call
+        assert sorted(
+            r.instance.calls for r in app.replicas["e"]
+        ) == [0, 1]
+
+    async def test_application_error_never_retried(self, controller):
+        class BuggyApp:
+            calls = 0
+
+            async def boom(self):
+                BuggyApp.calls += 1
+                raise ValueError("app bug")
+
+        BuggyApp.calls = 0
+        await controller.deploy(
+            "rt-app3",
+            [
+                DeploymentSpec(
+                    name="e",
+                    instance_factory=BuggyApp,
+                    num_replicas=2,
+                    autoscale=False,
+                )
+            ],
+        )
+        await asyncio.sleep(0.05)
+        handle = controller.get_handle("rt-app3")
+        with pytest.raises(ValueError, match="app bug"):
+            await handle.call("boom", options=RequestOptions(idempotent=True))
+        assert BuggyApp.calls == 1
+
+    async def test_deadline_bounds_retries(self, controller):
+        FlakyTransportApp.reset(10_000)
+        await controller.deploy(
+            "rt-app4",
+            [
+                DeploymentSpec(
+                    name="e",
+                    instance_factory=FlakyTransportApp,
+                    autoscale=False,
+                )
+            ],
+        )
+        await asyncio.sleep(0.05)
+        handle = controller.get_handle("rt-app4")
+        t0 = time.monotonic()
+        with pytest.raises((DeadlineExceeded, RetryableTransportError)):
+            await handle.call(
+                "ping",
+                options=RequestOptions(
+                    idempotent=True,
+                    deadline_s=0.5,
+                    max_attempts=1000,
+                    backoff_base_s=0.01,
+                ),
+            )
+        assert time.monotonic() - t0 < 2.0
+
+    async def test_per_attempt_timeout_propagates(self, controller):
+        class SlowApp:
+            async def slow(self):
+                await asyncio.sleep(5)
+                return "late"
+
+        await controller.deploy(
+            "rt-app5",
+            [DeploymentSpec(name="e", instance_factory=SlowApp, autoscale=False)],
+        )
+        await asyncio.sleep(0.05)
+        handle = controller.get_handle("rt-app5")
+        t0 = time.monotonic()
+        with pytest.raises(RetryableTransportError):
+            await handle.call(
+                "slow",
+                options=RequestOptions(timeout_s=0.1, max_attempts=2),
+            )
+        assert time.monotonic() - t0 < 2.0
+        # an impatient CALLER's timeout says nothing about replica
+        # health: the circuit breaker must not have counted it
+        assert controller._breaker_counts == {}
+
+    async def test_non_idempotent_fails_over_when_nothing_was_sent(
+        self, controller
+    ):
+        """A LOCAL ReplicaUnavailableError (routability check, e.g. a
+        replica caught DRAINING between pick and call) means the request
+        provably never left the process — even non-idempotent calls may
+        safely try another replica."""
+
+        class Ok:
+            async def ping(self):
+                return "ok"
+
+        app = await controller.deploy(
+            "rt-app-ne",
+            [
+                DeploymentSpec(
+                    name="e", instance_factory=Ok,
+                    num_replicas=2, autoscale=False,
+                )
+            ],
+        )
+        await asyncio.sleep(0.05)
+        draining = app.replicas["e"][0]
+        draining.state = ReplicaState.DRAINING
+        handle = controller.get_handle("rt-app-ne")
+        # several non-idempotent calls: round-robin would land half on
+        # the draining replica; every one must fail over, none may error
+        for _ in range(4):
+            assert await handle.call(
+                "ping", options=RequestOptions(idempotent=False)
+            ) == "ok"
+
+    async def test_non_idempotent_deadline_cut_raises_deadline(
+        self, controller
+    ):
+        """When the overall deadline is what cut the attempt short, the
+        caller gets DeadlineExceeded even on the non-idempotent path —
+        not a transport error."""
+
+        class SlowApp:
+            async def slow(self):
+                await asyncio.sleep(5)
+
+        await controller.deploy(
+            "rt-app-dl",
+            [DeploymentSpec(name="e", instance_factory=SlowApp, autoscale=False)],
+        )
+        await asyncio.sleep(0.05)
+        handle = controller.get_handle("rt-app-dl")
+        with pytest.raises(DeadlineExceeded):
+            await handle.call(
+                "slow",
+                options=RequestOptions(deadline_s=0.2, idempotent=False),
+            )
+
+    async def test_app_method_options_kwarg_passes_through(self, controller):
+        class OptionsApp:
+            async def configure(self, options=None):
+                return {"got": options}
+
+        await controller.deploy(
+            "rt-app6",
+            [DeploymentSpec(name="e", instance_factory=OptionsApp, autoscale=False)],
+        )
+        await asyncio.sleep(0.05)
+        handle = controller.get_handle("rt-app6")
+        # a plain dict is NOT a RequestOptions envelope — it reaches the app
+        assert await handle.call("configure", options={"a": 1}) == {
+            "got": {"a": 1}
+        }
+
+    async def test_pick_replica_waits_through_restart_window(self, controller):
+        class Ok:
+            async def ping(self):
+                return "ok"
+
+        app = await controller.deploy(
+            "rt-app7",
+            [DeploymentSpec(name="e", instance_factory=Ok, autoscale=False)],
+        )
+        await asyncio.sleep(0.05)
+        replica = app.replicas["e"][0]
+        replica.state = ReplicaState.UNHEALTHY  # restart window opens
+        handle = controller.get_handle("rt-app7")
+        task = asyncio.create_task(
+            handle.call(
+                "ping", options=RequestOptions(idempotent=True, deadline_s=5)
+            )
+        )
+        await asyncio.sleep(0.2)
+        assert not task.done()  # parked, not failed
+        replica.state = ReplicaState.HEALTHY
+        controller._replicas_changed.set()
+        assert await asyncio.wait_for(task, 3) == "ok"
+
+    async def test_deadline_covers_replica_wait_park(self, controller):
+        """Time spent parked in _pick_replica_wait counts against the
+        deadline: a replica appearing at the last moment must not grant
+        the attempt a fresh full budget (deadline bounds the WHOLE
+        request, wait included)."""
+
+        class SlowApp:
+            async def slow(self):
+                await asyncio.sleep(10)
+
+        app = await controller.deploy(
+            "rt-app9",
+            [DeploymentSpec(name="e", instance_factory=SlowApp, autoscale=False)],
+        )
+        await asyncio.sleep(0.05)
+        replica = app.replicas["e"][0]
+        replica.state = ReplicaState.UNHEALTHY  # park incoming requests
+        handle = controller.get_handle("rt-app9")
+        task = asyncio.create_task(
+            handle.call(
+                "slow",
+                options=RequestOptions(idempotent=True, deadline_s=0.8),
+            )
+        )
+        await asyncio.sleep(0.5)          # most of the budget spent parked
+        replica.state = ReplicaState.HEALTHY
+        controller._replicas_changed.set()
+        t0 = time.monotonic()
+        with pytest.raises((DeadlineExceeded, RetryableTransportError)):
+            await task
+        # ended ~when the deadline did, NOT after a fresh 10s attempt
+        assert time.monotonic() - t0 < 2.0
+
+    async def test_pick_replica_wait_gives_up_at_deadline(self, controller):
+        class Ok:
+            async def ping(self):
+                return "ok"
+
+        app = await controller.deploy(
+            "rt-app8",
+            [DeploymentSpec(name="e", instance_factory=Ok, autoscale=False)],
+        )
+        await asyncio.sleep(0.05)
+        app.replicas["e"][0].state = ReplicaState.UNHEALTHY
+        handle = controller.get_handle("rt-app8")
+        t0 = time.monotonic()
+        with pytest.raises((NoHealthyReplicasError, DeadlineExceeded)):
+            await handle.call(
+                "ping",
+                options=RequestOptions(idempotent=True, deadline_s=0.3),
+            )
+        assert time.monotonic() - t0 < 1.5
+
+
+class TestCircuitBreaker:
+    async def test_k_failures_eject_without_health_tick(self, controller):
+        FlakyTransportApp.reset(10_000)
+        app = await controller.deploy(
+            "cb-app",
+            [
+                DeploymentSpec(
+                    name="e",
+                    instance_factory=FlakyTransportApp,
+                    num_replicas=1,
+                    autoscale=False,
+                )
+            ],
+        )
+        await asyncio.sleep(0.05)
+        replica = app.replicas["e"][0]
+        handle = controller.get_handle("cb-app")
+        for _ in range(controller.breaker_threshold):
+            with pytest.raises(Exception):
+                await handle.call(
+                    "ping", options=RequestOptions(idempotent=False)
+                )
+        # ejected NOW — no health tick ran
+        assert replica.state == ReplicaState.UNHEALTHY
+        assert "circuit breaker" in replica.last_error
+        assert controller._wake_health.is_set()
+
+    async def test_success_resets_breaker(self, controller):
+        FlakyTransportApp.reset(1)
+        app = await controller.deploy(
+            "cb-app2",
+            [
+                DeploymentSpec(
+                    name="e",
+                    instance_factory=FlakyTransportApp,
+                    num_replicas=1,
+                    autoscale=False,
+                )
+            ],
+        )
+        await asyncio.sleep(0.05)
+        replica = app.replicas["e"][0]
+        handle = controller.get_handle("cb-app2")
+        with pytest.raises(RetryableTransportError):
+            await handle.call("ping")
+        assert controller._breaker_counts[replica.replica_id] == 1
+        await handle.call("ping")  # instance healed after first failure
+        assert replica.replica_id not in controller._breaker_counts
+        assert replica.state == ReplicaState.HEALTHY
+
+
+class TestDrain:
+    async def test_stop_drains_in_flight_and_rejects_new(self, controller):
+        release = asyncio.Event()
+        entered = asyncio.Event()
+
+        class SlowApp:
+            async def slow(self):
+                entered.set()
+                await release.wait()
+                return "finished"
+
+        app = await controller.deploy(
+            "dr-app",
+            [DeploymentSpec(name="e", instance_factory=SlowApp, autoscale=False)],
+        )
+        await asyncio.sleep(0.05)
+        replica = app.replicas["e"][0]
+        handle = controller.get_handle("dr-app")
+        in_flight = asyncio.create_task(handle.call("slow"))
+        await asyncio.wait_for(entered.wait(), 2)
+
+        stop_task = asyncio.create_task(replica.stop())
+        await asyncio.sleep(0.05)
+        assert replica.state == ReplicaState.DRAINING
+        # new calls rejected while draining, typed as placement error
+        with pytest.raises(ReplicaUnavailableError, match="not healthy"):
+            await replica.call("slow")
+        assert not stop_task.done()  # still waiting for the in-flight call
+        release.set()
+        assert await asyncio.wait_for(in_flight, 2) == "finished"
+        await asyncio.wait_for(stop_task, 2)
+        assert replica.state == ReplicaState.STOPPED
+
+    async def test_drain_rejects_semaphore_parked_calls(self, controller):
+        """A call that passed the routability check but is PARKED on the
+        request semaphore when drain begins must be rejected (typed, so
+        the router fails it over) — not executed against the instance
+        after stop() tore it down."""
+        release = asyncio.Event()
+        entered = []
+
+        class SlowApp:
+            async def slow(self):
+                entered.append(1)
+                await release.wait()
+                return "done"
+
+        app = await controller.deploy(
+            "dr-app4",
+            [
+                DeploymentSpec(
+                    name="e", instance_factory=SlowApp,
+                    max_ongoing_requests=1, autoscale=False,
+                )
+            ],
+        )
+        await asyncio.sleep(0.05)
+        replica = app.replicas["e"][0]
+        first = asyncio.create_task(replica.call("slow"))
+        await asyncio.sleep(0.05)          # first holds the semaphore
+        parked = asyncio.create_task(replica.call("slow"))
+        await asyncio.sleep(0.05)          # parked passed the state check
+        stop_task = asyncio.create_task(replica.stop())
+        await asyncio.sleep(0.05)
+        release.set()
+        assert await asyncio.wait_for(first, 2) == "done"
+        with pytest.raises(ReplicaUnavailableError):
+            await asyncio.wait_for(parked, 2)
+        await asyncio.wait_for(stop_task, 2)
+        assert entered == [1]              # the parked call never ran
+
+    async def test_drain_timeout_bounds_stop(self, controller):
+        class StuckApp:
+            async def stuck(self):
+                await asyncio.sleep(60)
+
+        app = await controller.deploy(
+            "dr-app2",
+            [DeploymentSpec(name="e", instance_factory=StuckApp, autoscale=False)],
+        )
+        await asyncio.sleep(0.05)
+        replica = app.replicas["e"][0]
+        handle = controller.get_handle("dr-app2")
+        stuck = asyncio.create_task(handle.call("stuck"))
+        await asyncio.sleep(0.05)
+        t0 = time.monotonic()
+        await replica.stop(drain_timeout_s=0.2)
+        assert 0.15 < time.monotonic() - t0 < 2.0
+        assert replica.state == ReplicaState.STOPPED
+        stuck.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await stuck
+
+    async def test_undeploy_lets_in_flight_finish(self, controller):
+        release = asyncio.Event()
+        entered = asyncio.Event()
+
+        class SlowApp:
+            async def slow(self):
+                entered.set()
+                await release.wait()
+                return "done"
+
+        await controller.deploy(
+            "dr-app3",
+            [DeploymentSpec(name="e", instance_factory=SlowApp, autoscale=False)],
+        )
+        await asyncio.sleep(0.05)
+        handle = controller.get_handle("dr-app3")
+        in_flight = asyncio.create_task(handle.call("slow"))
+        await asyncio.wait_for(entered.wait(), 2)
+        undeploy = asyncio.create_task(controller.undeploy("dr-app3"))
+        await asyncio.sleep(0.05)
+        release.set()
+        assert await asyncio.wait_for(in_flight, 2) == "done"
+        await asyncio.wait_for(undeploy, 2)
+
+
+class TestConcurrentHealthTick:
+    async def test_one_slow_replica_does_not_stall_others(self, controller):
+        order = []
+
+        class SlowHealth:
+            async def check_health(self):
+                order.append("slow-start")
+                await asyncio.sleep(0.3)
+                order.append("slow-end")
+
+            async def ping(self):
+                return "ok"
+
+        class FastHealth:
+            async def check_health(self):
+                order.append("fast")
+
+            async def ping(self):
+                return "ok"
+
+        await controller.deploy(
+            "h-slow",
+            [DeploymentSpec(name="e", instance_factory=SlowHealth, autoscale=False)],
+        )
+        await controller.deploy(
+            "h-fast",
+            [DeploymentSpec(name="e", instance_factory=FastHealth, autoscale=False)],
+        )
+        await asyncio.sleep(0.05)
+        t0 = time.monotonic()
+        await controller.health_tick()
+        elapsed = time.monotonic() - t0
+        # serial would be >= 0.3 with "fast" gated behind "slow-end";
+        # concurrent runs "fast" while "slow" sleeps
+        assert order.index("fast") < order.index("slow-end")
+        assert elapsed < 1.0
+
+
+# ---------------------------------------------------------------------------
+# in-process multi-host chaos (real websockets, deterministic kills)
+# ---------------------------------------------------------------------------
+
+CHAOS_MANIFEST = """\
+name: Chaos App
+id: chaos-app
+id_emoji: "\U0001F9EA"
+description: idempotent arithmetic for chaos traffic
+type: tpu-serve
+version: 1.0.0
+deployments:
+  - chaos_dep:ChaosDep
+authorized_users: ["*"]
+deployment_config:
+  chaos_dep:
+    num_replicas: 2
+    min_replicas: 2
+    max_replicas: 2
+    chips: 3
+    autoscale: false
+"""
+
+CHAOS_SOURCE = '''\
+import os
+
+from bioengine_tpu.rpc import schema_method
+
+
+class ChaosDep:
+    def __init__(self):
+        self.calls = 0
+
+    @schema_method
+    async def add(self, a: int, b: int, context=None):
+        """Idempotent arithmetic."""
+        self.calls += 1
+        return {"sum": a + b}
+'''
+
+
+def _write_chaos_app(tmp_path: Path) -> Path:
+    app_dir = tmp_path / "chaos-src"
+    app_dir.mkdir(exist_ok=True)
+    (app_dir / "manifest.yaml").write_text(CHAOS_MANIFEST)
+    (app_dir / "chaos_dep.py").write_text(CHAOS_SOURCE)
+    return app_dir
+
+
+def _no_local_chips() -> ClusterState:
+    return ClusterState(TpuTopology(chips=(), n_hosts=1, platform="cpu"))
+
+
+@pytest.fixture()
+async def chaos_plane(tmp_path):
+    server = RpcServer(host="127.0.0.1", admin_users=["admin"])
+    await server.start()
+    token = server.issue_token("admin", is_admin=True)
+    controller = ServeController(_no_local_chips(), health_check_period=3600)
+    controller.attach_rpc(server, admin_users=["admin"])
+    hosts = []
+
+    async def spawn_host(host_id: str, rejoin: bool = True) -> WorkerHost:
+        host = WorkerHost(
+            server_url=server.url,
+            token=token,
+            host_id=host_id,
+            workspace_dir=tmp_path / f"ws-{host_id}",
+            rejoin=rejoin,
+        )
+        await host.start()
+        hosts.append(host)
+        return host
+
+    try:
+        yield server, controller, spawn_host, tmp_path
+    finally:
+        for host in hosts:
+            try:
+                await host.stop()
+            except Exception:
+                pass
+        await controller.stop()
+        await server.stop()
+
+
+async def _kill_host(host: WorkerHost) -> None:
+    """Simulate host death: sever the websocket with rejoin suppressed
+    (the in-process analog of SIGKILL — the server sees the socket
+    close, in-flight provider calls fail, the service vanishes)."""
+    host.rejoin = False
+    host.connection.auto_reconnect = False
+    host.connection._closing = True
+    await host.connection._abort_connection()
+
+
+async def _deploy_chaos_app(controller, tmp_path):
+    builder = AppBuilder(workdir_root=tmp_path / "apps")
+    built = builder.build(
+        app_id="chaos-app", local_path=_write_chaos_app(tmp_path)
+    )
+    await controller.deploy("chaos-app", built.specs)
+    return controller.apps["chaos-app"].replicas["chaos_dep"]
+
+
+class TestChaosMultiHost:
+    async def test_host_death_zero_failed_idempotent_requests(
+        self, chaos_plane
+    ):
+        """Acceptance: 2 replicas across 2 hosts under continuous
+        idempotent traffic; killing one host produces ZERO failed
+        requests and the replica is re-placed within one health
+        period. Non-idempotent calls fail fast exactly once."""
+        server, controller, spawn_host, tmp_path = chaos_plane
+        h1 = await spawn_host("h1")
+        h2 = await spawn_host("h2")
+        replicas = await _deploy_chaos_app(controller, tmp_path)
+        assert sorted(r.host_id for r in replicas) == ["h1", "h2"]
+        handle = controller.get_handle("chaos-app")
+        opts = RequestOptions(
+            idempotent=True, deadline_s=20, max_attempts=8
+        )
+
+        failures: list[Exception] = []
+        successes = [0]
+        kill_at = asyncio.Event()
+
+        async def traffic(worker_id: int):
+            for i in range(30):
+                try:
+                    r = await handle.call("add", worker_id, i, options=opts)
+                    assert r["sum"] == worker_id + i
+                    successes[0] += 1
+                except Exception as e:  # noqa: BLE001 — counted, not raised
+                    failures.append(e)
+                if i == 8 and worker_id == 0:
+                    kill_at.set()
+                await asyncio.sleep(0.005)
+
+        tasks = [asyncio.create_task(traffic(w)) for w in range(4)]
+        await asyncio.wait_for(kill_at.wait(), 10)
+        victim = next(h for h in (h1, h2) if h.host_id == "h1")
+        await _kill_host(victim)
+
+        # recovery loop: prune + breaker + restart, all inside what one
+        # health period covers in production
+        recovered = False
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            await controller.health_tick()
+            reps = controller.apps["chaos-app"].replicas["chaos_dep"]
+            routable = [
+                r
+                for r in reps
+                if r.state in (ReplicaState.HEALTHY, ReplicaState.TESTING)
+            ]
+            if len(routable) == 2 and all(
+                r.host_id == "h2" for r in routable
+            ):
+                recovered = True
+                break
+            await asyncio.sleep(0.1)
+        await asyncio.gather(*tasks)
+
+        assert failures == []          # ZERO failed idempotent requests
+        assert successes[0] == 120
+        assert recovered, "replica was not re-placed on the survivor"
+        # chip accounting: released exactly once — the dead host holds
+        # nothing, the survivor holds both replicas' leases
+        assert controller.cluster_state.hosts["h1"].chips_in_use == {}
+        assert not controller.cluster_state.hosts["h1"].alive
+        h2_leases = controller.cluster_state.hosts["h2"].chips_in_use
+        assert len(h2_leases) == 6  # 2 replicas x 3 chips, no double lease
+        assert len(set(h2_leases.values())) == 2
+
+    async def test_non_idempotent_fails_fast_exactly_once_remote(
+        self, chaos_plane
+    ):
+        server, controller, spawn_host, tmp_path = chaos_plane
+        await spawn_host("h1")
+        await spawn_host("h2")
+        await _deploy_chaos_app(controller, tmp_path)
+        handle = controller.get_handle("chaos-app")
+        # first routed replica call dies in transport on the host
+        faults.configure("host.replica_call", "raise", nth=1, count=1)
+        with pytest.raises(RetryableTransportError, match="not retried"):
+            await handle.call(
+                "add", 1, 1, options=RequestOptions(idempotent=False)
+            )
+        assert faults.hits("host.replica_call") == 1  # no silent retry
+        # the same failure under an idempotent envelope fails over
+        faults.configure("host.replica_call", "raise", nth=1, count=1)
+        result = await handle.call(
+            "add", 20, 22, options=RequestOptions(idempotent=True)
+        )
+        assert result["sum"] == 42
+        assert faults.hits("host.replica_call") == 2
+
+    async def test_restart_path_with_fault_point_kill(self, chaos_plane):
+        """Satellite: kill a host via the fault layer mid-call; the
+        replica is re-placed on the surviving host and chip accounting
+        is released exactly once (no leak, no double release)."""
+        server, controller, spawn_host, tmp_path = chaos_plane
+        h1 = await spawn_host("h1", rejoin=False)
+        await spawn_host("h2")
+        replicas = await _deploy_chaos_app(controller, tmp_path)
+        state = controller.cluster_state
+        victim = next(r for r in replicas if r.host_id == "h1")
+        dead_id = victim.replica_id
+        handle = controller.get_handle("chaos-app")
+
+        # round-robin alternates h1, h2, h1, ... — the 3rd hit is the
+        # 2nd call served by h1, and it severs h1's websocket mid-call
+        h1.connection.auto_reconnect = False
+        faults.configure("host.replica_call", "drop", nth=3, count=1)
+        opts = RequestOptions(idempotent=True, deadline_s=20, max_attempts=8)
+        for i in range(8):
+            r = await handle.call("add", i, 1, options=opts)
+            assert r["sum"] == i + 1
+        # every request succeeded across the kill; now heal placement
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            await controller.health_tick()
+            reps = controller.apps["chaos-app"].replicas["chaos_dep"]
+            if (
+                len(reps) == 2
+                and all(r.host_id == "h2" for r in reps)
+                and all(
+                    r.state
+                    in (ReplicaState.HEALTHY, ReplicaState.TESTING)
+                    for r in reps
+                )
+            ):
+                break
+            await asyncio.sleep(0.1)
+
+        assert state.hosts["h1"].chips_in_use == {}
+        assert len(state.hosts["h2"].chips_in_use) == 6
+        # the dead replica's record is dead exactly once, successor alive
+        dead_recs = [r for r in state.replicas("chaos-app") if not r.alive]
+        assert dead_id in {r.replica_id for r in dead_recs}
+        live = [r for r in state.replicas("chaos-app") if r.alive]
+        assert len(live) == 2
+
+    async def test_host_rejoin_keeps_warm_replicas(self, chaos_plane):
+        """A connection BLIP (not a death): the host auto-reconnects,
+        re-registers, and the controller re-adopts the still-warm
+        replica — same instance object, no rebuild, chips re-leased."""
+        server, controller, spawn_host, tmp_path = chaos_plane
+        h1 = await spawn_host("h1", rejoin=True)
+        builder = AppBuilder(workdir_root=tmp_path / "apps")
+        built = builder.build(
+            app_id="chaos-app", local_path=_write_chaos_app(tmp_path)
+        )
+        # single replica fits this single-host variant
+        built.specs[0].num_replicas = 1
+        built.specs[0].min_replicas = 1
+        await controller.deploy("chaos-app", built.specs)
+        replica = controller.apps["chaos-app"].replicas["chaos_dep"][0]
+        assert isinstance(replica, RemoteReplica)
+        warm_instance = h1.replicas[replica.replica_id].instance
+        handle = controller.get_handle("chaos-app")
+        assert (await handle.call("add", 1, 1))["sum"] == 2
+
+        await h1.connection._abort_connection()  # network blip
+        # wait for the client to heal + host to rejoin
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if (
+                h1.connection.connected
+                and controller.cluster_state.hosts["h1"].alive
+                and controller.cluster_state.hosts["h1"].chips_in_use
+            ):
+                break
+            await asyncio.sleep(0.05)
+        assert h1.connection.connected
+        # the warm replica was re-adopted, not rebuilt
+        assert h1.replicas[replica.replica_id].instance is warm_instance
+        assert replica.state in (ReplicaState.HEALTHY, ReplicaState.TESTING)
+        assert (
+            controller.cluster_state.hosts["h1"].chips_in_use
+            == {d: replica.replica_id for d in replica.device_ids}
+        )
+        # and it serves traffic again (calls before the tick succeed)
+        result = await handle.call(
+            "add", 2, 3, options=RequestOptions(idempotent=True)
+        )
+        assert result["sum"] == 5
+        # a later health tick keeps exactly one replica (no duplicate)
+        await controller.health_tick()
+        assert len(controller.apps["chaos-app"].replicas["chaos_dep"]) == 1
+
+    async def test_rejoin_after_replacement_drops_stale_replica(
+        self, chaos_plane
+    ):
+        """If the controller already re-placed the replica before the
+        host rejoined, the rejoin answer tells the host to discard its
+        stale copy (and the deployment does not end up over-replicated)."""
+        server, controller, spawn_host, tmp_path = chaos_plane
+        h1 = await spawn_host("h1", rejoin=True)
+        h2 = await spawn_host("h2")
+        builder = AppBuilder(workdir_root=tmp_path / "apps")
+        built = builder.build(
+            app_id="chaos-app", local_path=_write_chaos_app(tmp_path)
+        )
+        built.specs[0].num_replicas = 1
+        built.specs[0].min_replicas = 1
+        await controller.deploy("chaos-app", built.specs)
+        replica = controller.apps["chaos-app"].replicas["chaos_dep"][0]
+        first_host = replica.host_id
+        other = "h2" if first_host == "h1" else "h1"
+        victim = h1 if first_host == "h1" else h2
+
+        # gate the victim's reconnect behind an event so the controller
+        # DETERMINISTICALLY re-places the replica before the rejoin
+        gate = asyncio.Event()
+        orig_establish = victim.connection._establish
+
+        async def gated_establish():
+            await gate.wait()
+            await orig_establish()
+
+        victim.connection._establish = gated_establish
+        await victim.connection._abort_connection()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            await controller.health_tick()
+            reps = controller.apps["chaos-app"].replicas["chaos_dep"]
+            if reps and reps[0].host_id == other and reps[0].state in (
+                ReplicaState.HEALTHY,
+                ReplicaState.TESTING,
+            ):
+                break
+            await asyncio.sleep(0.05)
+        reps = controller.apps["chaos-app"].replicas["chaos_dep"]
+        assert reps[0].host_id == other
+        gate.set()  # now let the victim rejoin
+
+        # when the victim rejoins it must drop its stale warm copy
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if victim.connection.connected and not victim.replicas:
+                break
+            await asyncio.sleep(0.05)
+        assert victim.replicas == {}
+        await controller.health_tick()
+        assert len(controller.apps["chaos-app"].replicas["chaos_dep"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# RPC client reconnect (transport layer on its own)
+# ---------------------------------------------------------------------------
+
+
+class TestClientReconnect:
+    async def test_inflight_fails_fast_and_services_reregister(self):
+        from bioengine_tpu.rpc.client import ConnectionLost, connect_to_server
+
+        server = RpcServer(host="127.0.0.1", admin_users=["admin"])
+        await server.start()
+        token = server.issue_token("admin", is_admin=True)
+        conn = await connect_to_server(
+            {"server_url": server.url, "token": token, "reconnect": True}
+        )
+        try:
+            release = asyncio.Event()
+
+            async def slow_echo(x):
+                await release.wait()
+                return x
+
+            svc = await conn.register_service(
+                {"id": "reconnect-svc", "echo": slow_echo,
+                 "fast": lambda x: x * 2}
+            )
+            full_id = svc["id"]
+            # a call in flight THROUGH the server to our own service
+            in_flight = asyncio.create_task(
+                server.call_service_method(full_id, "echo", ("v",))
+            )
+            await asyncio.sleep(0.1)
+            t0 = time.monotonic()
+            await conn._abort_connection()
+            # the provider-side drop fails the routed call fast (server
+            # classifies provider loss as ConnectionError)
+            with pytest.raises(ConnectionError):
+                await asyncio.wait_for(in_flight, 5)
+            assert time.monotonic() - t0 < 5
+            release.set()
+
+            # the client heals itself and re-registers its services
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if conn.connected and any(
+                    s["id"].endswith("/reconnect-svc")
+                    for s in server.list_services()
+                ):
+                    break
+                await asyncio.sleep(0.05)
+            assert conn.connected
+            result = await server.call_service_method(
+                full_id, "fast", (21,)
+            )
+            assert result == 42
+        finally:
+            await conn.disconnect()
+            await server.stop()
+
+    async def test_disconnect_suppresses_reconnect(self):
+        from bioengine_tpu.rpc.client import connect_to_server
+
+        server = RpcServer(host="127.0.0.1")
+        await server.start()
+        conn = await connect_to_server(
+            {"server_url": server.url, "reconnect": True}
+        )
+        await conn.disconnect()
+        await asyncio.sleep(0.3)
+        assert not conn.connected  # no zombie reconnect
+        assert conn._reconnect_task is None
+        await server.stop()
+
+    async def test_client_send_fault_point(self):
+        from bioengine_tpu.rpc.client import connect_to_server
+
+        server = RpcServer(host="127.0.0.1")
+        await server.start()
+        conn = await connect_to_server(
+            {"server_url": server.url, "reconnect": True}
+        )
+        try:
+            faults.configure("rpc.client.send", "drop", nth=1, count=1)
+            with pytest.raises(ConnectionError):
+                await conn.list_services()
+            # reconnect heals; the next call goes through
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not conn.connected:
+                await asyncio.sleep(0.05)
+            assert isinstance(await conn.list_services(), list)
+        finally:
+            await conn.disconnect()
+            await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# slow soak: repeated kill/rejoin cycles (scripts/workflows/chaos.sh)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+async def test_chaos_soak_no_leaks(chaos_plane):
+    """Repeated blip/heal cycles under traffic: every request succeeds,
+    no background tasks or pending futures leak, transport stats stay
+    sane, chip accounting stays exact."""
+    import os
+
+    from bioengine_tpu.utils import tasks as task_registry
+
+    server, controller, spawn_host, tmp_path = chaos_plane
+    h1 = await spawn_host("h1", rejoin=True)
+    builder = AppBuilder(workdir_root=tmp_path / "apps")
+    built = builder.build(
+        app_id="chaos-app", local_path=_write_chaos_app(tmp_path)
+    )
+    built.specs[0].num_replicas = 1
+    built.specs[0].min_replicas = 1
+    await controller.deploy("chaos-app", built.specs)
+    replica = controller.apps["chaos-app"].replicas["chaos_dep"][0]
+    handle = controller.get_handle("chaos-app")
+    opts = RequestOptions(idempotent=True, deadline_s=30, max_attempts=10)
+
+    cycles = int(os.environ.get("BIOENGINE_CHAOS_CYCLES", "5"))
+    h1.connection.reconnect_max_backoff_s = 0.5
+    for cycle in range(cycles):
+        results = await asyncio.gather(
+            *(handle.call("add", cycle, i, options=opts) for i in range(10))
+        )
+        assert [r["sum"] for r in results] == [cycle + i for i in range(10)]
+        await h1.connection._abort_connection()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if (
+                h1.connection.connected
+                and controller.cluster_state.hosts["h1"].alive
+                and controller.cluster_state.hosts["h1"].chips_in_use
+            ):
+                break
+            await asyncio.sleep(0.05)
+        assert h1.connection.connected, f"cycle {cycle}: never rejoined"
+
+    # final traffic burst must be fully healthy
+    results = await asyncio.gather(
+        *(handle.call("add", 0, i, options=opts) for i in range(20))
+    )
+    assert [r["sum"] for r in results] == list(range(20))
+
+    # leak checks: pending futures drained, supervised task registry
+    # settles, replica inventory exact, chip accounting exact
+    await asyncio.sleep(0.5)
+    assert controller.cluster_state.hosts["h1"].chips_in_use == {
+        d: replica.replica_id for d in replica.device_ids
+    }
+    assert list(h1.replicas) == [replica.replica_id]
+    assert h1.connection._pending == {}
+    assert server._pending == {}
+    lingering = [
+        t for t in task_registry._BACKGROUND_TASKS if not t.done()
+    ]
+    assert len(lingering) < 10, lingering
+    stats = server.describe()["transport"]
+    assert stats["msgs_out"] > 0  # stats surface stays wired

@@ -311,3 +311,156 @@ async def test_zarr_batch_loader(data_server):
     assert len(got) == 4
     np.testing.assert_array_equal(np.concatenate(got, axis=0), images)
     await store.aclose()
+
+
+# ---- retrying HTTP GET (datasets/net.py) -------------------------------------
+
+
+class TestGetUrlWithRetry:
+    """Full-jitter backoff + Retry-After handling (fault-tolerance PR)."""
+
+    def _client(self, handler):
+        import httpx
+
+        return httpx.AsyncClient(transport=httpx.MockTransport(handler))
+
+    async def test_retries_5xx_then_succeeds(self, monkeypatch):
+        import httpx
+
+        from bioengine_tpu.datasets import net
+
+        calls = {"n": 0}
+
+        def handler(request):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                return httpx.Response(503)
+            return httpx.Response(200, text="ok")
+
+        sleeps = []
+
+        async def fake_sleep(s):
+            sleeps.append(s)
+
+        monkeypatch.setattr(net.asyncio, "sleep", fake_sleep)
+        resp = await net.get_url_with_retry(
+            "http://x/u", client=self._client(handler)
+        )
+        assert resp.status_code == 200
+        assert calls["n"] == 3
+        # full jitter: each delay uniform in [0, base * 2**attempt]
+        assert len(sleeps) == 2
+        assert 0 <= sleeps[0] <= net.BACKOFF_SECONDS
+        assert 0 <= sleeps[1] <= net.BACKOFF_SECONDS * 2
+
+    async def test_429_honors_retry_after_seconds(self, monkeypatch):
+        import httpx
+
+        from bioengine_tpu.datasets import net
+
+        calls = {"n": 0}
+
+        def handler(request):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                return httpx.Response(429, headers={"Retry-After": "1.5"})
+            return httpx.Response(200, text="ok")
+
+        sleeps = []
+
+        async def fake_sleep(s):
+            sleeps.append(s)
+
+        monkeypatch.setattr(net.asyncio, "sleep", fake_sleep)
+        resp = await net.get_url_with_retry(
+            "http://x/u", client=self._client(handler)
+        )
+        assert resp.status_code == 200
+        # the server's stated budget is the FLOOR for the delay
+        assert sleeps == [1.5]
+
+    async def test_429_retry_after_http_date_and_cap(self, monkeypatch):
+        import httpx
+
+        from bioengine_tpu.datasets import net
+
+        calls = {"n": 0}
+
+        def handler(request):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                # hostile/huge delta-seconds must be capped
+                return httpx.Response(429, headers={"Retry-After": "9999"})
+            return httpx.Response(200, text="ok")
+
+        sleeps = []
+
+        async def fake_sleep(s):
+            sleeps.append(s)
+
+        monkeypatch.setattr(net.asyncio, "sleep", fake_sleep)
+        await net.get_url_with_retry(
+            "http://x/u", client=self._client(handler)
+        )
+        assert sleeps == [net.RETRY_AFTER_CAP_SECONDS]
+
+    async def test_4xx_not_retried(self):
+        import httpx
+
+        from bioengine_tpu.datasets import net
+
+        calls = {"n": 0}
+
+        def handler(request):
+            calls["n"] += 1
+            return httpx.Response(404)
+
+        with pytest.raises(httpx.HTTPStatusError):
+            await net.get_url_with_retry(
+                "http://x/u", client=self._client(handler)
+            )
+        assert calls["n"] == 1
+
+    def test_retry_after_parser(self):
+        import httpx
+
+        from bioengine_tpu.datasets.net import _retry_after_seconds
+
+        assert _retry_after_seconds(httpx.Response(429)) is None
+        assert (
+            _retry_after_seconds(
+                httpx.Response(429, headers={"Retry-After": "7"})
+            )
+            == 7.0
+        )
+        assert (
+            _retry_after_seconds(
+                httpx.Response(429, headers={"Retry-After": "garbage"})
+            )
+            is None
+        )
+        # HTTP-date in the past clamps to 0, never negative
+        assert (
+            _retry_after_seconds(
+                httpx.Response(
+                    429,
+                    headers={
+                        "Retry-After": "Wed, 21 Oct 2015 07:28:00 GMT"
+                    },
+                )
+            )
+            == 0.0
+        )
+        # '-0000' parses to a NAIVE datetime — must not crash on the
+        # aware-naive subtraction (treated as UTC per RFC 7231)
+        assert (
+            _retry_after_seconds(
+                httpx.Response(
+                    429,
+                    headers={
+                        "Retry-After": "Wed, 21 Oct 2015 07:28:00 -0000"
+                    },
+                )
+            )
+            == 0.0
+        )

@@ -361,3 +361,22 @@ jax.jit(lambda x: (x @ x).sum())(jnp.ones((64, 64))).block_until_ready()
         env={**os.environ, "BIOENGINE_COMPILE_CACHE": "off"},
     )
     assert r.returncode == 0, r.stderr[-1500:]
+
+
+def test_full_jitter_delay_windows_and_overflow():
+    """Shared backoff helper: uniform in [0, min(cap, base*2**n)], and
+    absurd attempt counts must clamp instead of overflowing float
+    (0.2 * 2**1075 would raise OverflowError)."""
+    from bioengine_tpu.utils.backoff import full_jitter_delay
+
+    for attempt, base, cap, window in [
+        (0, 0.2, 5.0, 0.2),
+        (3, 0.2, 5.0, 1.6),
+        (10, 0.2, 5.0, 5.0),       # capped
+    ]:
+        for _ in range(50):
+            d = full_jitter_delay(attempt, base, cap)
+            assert 0.0 <= d <= window
+    # a partition lasting thousands of attempts must not kill the loop
+    assert 0.0 <= full_jitter_delay(100_000, 0.2, 5.0) <= 5.0
+    assert 0.0 <= full_jitter_delay(-3, 0.2, 5.0) <= 0.2
