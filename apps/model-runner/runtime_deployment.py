@@ -63,7 +63,12 @@ class Pipeline:
         package_path: Path,
         weights_format: str | None = None,
         default_blocksize_parameter: int | None = None,
+        devices=None,
     ):
+        # the replica's leased chip group (list of jax.Device): the XLA
+        # engine builds its dp mesh over exactly these chips. None =
+        # legacy single-device behavior.
+        self.devices = list(devices) if devices else None
         self.package_path = Path(package_path)
         rdf_path = self.package_path / "rdf.yaml"
         self.rdf = load_model_rdf(rdf_path)
@@ -125,6 +130,7 @@ class Pipeline:
                 divisor=getattr(model, "divisor", 1),
                 z_divisor=getattr(model, "z_divisor", 1),
                 config=config,
+                devices=self.devices,
             )
             return "xla", engine
 
@@ -327,6 +333,7 @@ class RuntimeDeployment:
         self.max_pipelines = max_pipelines
         self.batch_max = batch_max
         self.batch_wait_ms = batch_wait_ms
+        self._devices = None  # set from the replica lease in async_init
         self._pipelines: OrderedDict[str, Pipeline] = OrderedDict()
         self._lock = asyncio.Lock()
         self._batcher = None
@@ -336,6 +343,16 @@ class RuntimeDeployment:
 
         self.backend = jax.default_backend()
         self.device_count = jax.local_device_count()
+        # the replica lifecycle injects the leased chip group before
+        # async_init (serving/replica.py); resolve it onto jax devices
+        # once so every pipeline this replica builds shares the mesh
+        lease = getattr(self, "bioengine_device_ids", None)
+        if lease:
+            from bioengine_tpu.runtime.engine import resolve_devices
+
+            self._devices = resolve_devices(list(lease))
+        else:
+            self._devices = None
         if self.batch_max > 1:
             from bioengine_tpu.serving import ContinuousBatcher
 
@@ -367,17 +384,51 @@ class RuntimeDeployment:
             return  # nothing loaded is a healthy state
         # a wedged XLA client would hang here and fail the health check
 
+    @staticmethod
+    def _status_key(key: str, p: "Pipeline") -> str:
+        """Status-entry key: model key PLUS the cache-key prefix — the
+        same model loaded with different weights_format/blocksize is a
+        different pipeline and must not collapse into one entry. Shared
+        by pipeline_stats and mesh_info so the controller can join the
+        two views on the same key."""
+        return f"{p._model_key()}#{key[:8]}"
+
     def pipeline_stats(self) -> dict:
         """Per-pipeline overlapped-pipeline accounting — picked up by
         Replica.describe (and from there the controller's
-        get_app_status). Keyed on model key PLUS the cache-key prefix:
-        the same model loaded with different weights_format/blocksize
-        is a different pipeline and must not collapse into one entry."""
+        get_app_status)."""
         return {
-            f"{p._model_key()}#{key[:8]}": p.pipeline_stats()
+            self._status_key(key, p): p.pipeline_stats()
             for key, p in self._pipelines.items()
             if p.backend == "xla"
         }
+
+    def mesh_info(self) -> dict:
+        """How this replica's leased chip group is used — mesh shape,
+        chip ids, and per-chip utilization per loaded engine. Surfaced
+        by Replica.describe so the controller can see sharding health
+        (a K-chip lease running a 1-chip mesh is a provisioning bug)."""
+        info: dict = {
+            "lease": list(getattr(self, "bioengine_device_ids", []) or []),
+            "engines": {},
+        }
+        for key, p in self._pipelines.items():
+            describe = getattr(p.engine, "describe", None)
+            if callable(describe):
+                info["engines"][self._status_key(key, p)] = describe()
+        # mesh_shape comes from the engines (the one source of mesh
+        # truth — a tp axis threaded through later is reported without
+        # touching this code); until the first pipeline loads, fall back
+        # to the shape the lease implies. None = legacy single-device
+        # path, matching engine.describe()["mesh"].
+        shapes = [e.get("mesh") for e in info["engines"].values()]
+        if shapes:
+            info["mesh_shape"] = shapes[0]
+        elif self._devices and len(self._devices) > 1:
+            info["mesh_shape"] = {"dp": len(self._devices)}
+        else:
+            info["mesh_shape"] = None
+        return info
 
     async def close(self) -> None:
         """Replica.stop's hook: flush the batcher and release every
@@ -399,6 +450,18 @@ class RuntimeDeployment:
         blob = json.dumps({"rdf_path": rdf_path, **kwargs}, sort_keys=True)
         return hashlib.md5(blob.encode()).hexdigest()
 
+    def _mesh_tag(self) -> str:
+        """Mesh-shape component of the pipeline cache key: the same
+        model loaded on a different chip group compiles different
+        (sharded) programs and must be a different pipeline entry. A
+        1-chip lease IS the legacy single-device path (engine semantics),
+        so it shares the '1dev' tag with the no-lease case. One
+        definition of mesh identity: engine.mesh_cache_tag, the same
+        function the compiled-program cache key uses."""
+        from bioengine_tpu.runtime.engine import mesh_cache_tag
+
+        return mesh_cache_tag(len(self._devices) if self._devices else 1)
+
     async def _get_pipeline(
         self,
         rdf_path: str,
@@ -409,6 +472,7 @@ class RuntimeDeployment:
             rdf_path,
             weights_format=weights_format,
             blocksize=default_blocksize_parameter,
+            mesh=self._mesh_tag(),
         )
         async with self._lock:
             if key in self._pipelines:
@@ -420,6 +484,7 @@ class RuntimeDeployment:
             Path(rdf_path).parent if rdf_path.endswith(".yaml") else rdf_path,
             weights_format,
             default_blocksize_parameter,
+            self._devices,
         )
         async with self._lock:
             existing = self._pipelines.get(key)
@@ -469,6 +534,7 @@ class RuntimeDeployment:
                         rdf_path,
                         weights_format=weights_format,
                         blocksize=default_blocksize_parameter,
+                        mesh=self._mesh_tag(),
                     ),
                     tuple(array.shape[1:]),
                 )

@@ -26,8 +26,20 @@ from __future__ import annotations
 import ctypes
 import ctypes.util
 import functools
+import os
 import struct
 from typing import Optional
+
+# Codec libraries must be loaded with RTLD_DEEPBIND where the platform
+# has it: frameworks that statically link their own (different-version)
+# copies of zstd/lz4 and export the symbols into the process's global
+# scope — libtensorflow_framework.so.2 exports 290 ZSTD_* symbols and
+# jax.profiler's trace export imports it — would otherwise interpose
+# the system library's INTERNAL cross-calls. The mixed-version internals
+# corrupt the stack (observed: ZSTD_compress -> "stack smashing
+# detected" after any jax.profiler trace in the same process). DEEPBIND
+# makes each dlopen'd codec library resolve its own symbols first.
+_DLOPEN_MODE = ctypes.DEFAULT_MODE | getattr(os, "RTLD_DEEPBIND", 0)
 
 __all__ = [
     "CodecUnavailable",
@@ -64,7 +76,7 @@ def _libblosc() -> Optional[ctypes.CDLL]:
         if not name:
             continue
         try:
-            lib = ctypes.CDLL(name)
+            lib = ctypes.CDLL(name, mode=_DLOPEN_MODE)
         except OSError:
             continue
         lib.blosc_compress_ctx.restype = ctypes.c_int
@@ -183,7 +195,7 @@ def _libzstd() -> Optional[ctypes.CDLL]:
         if not name:
             continue
         try:
-            lib = ctypes.CDLL(name)
+            lib = ctypes.CDLL(name, mode=_DLOPEN_MODE)
         except OSError:
             continue
         lib.ZSTD_compressBound.restype = ctypes.c_size_t
@@ -266,7 +278,7 @@ def _liblz4() -> Optional[ctypes.CDLL]:
         if not name:
             continue
         try:
-            lib = ctypes.CDLL(name)
+            lib = ctypes.CDLL(name, mode=_DLOPEN_MODE)
         except OSError:
             continue
         lib.LZ4_compressBound.restype = ctypes.c_int

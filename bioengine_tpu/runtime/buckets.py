@@ -49,11 +49,32 @@ def bucket_shape(
     )
 
 
-def bucket_batch(n: int, ladder: Sequence[int] = (1, 2, 4, 8, 16, 32, 64)) -> int:
+def bucket_batch(
+    n: int,
+    ladder: Sequence[int] = (1, 2, 4, 8, 16, 32, 64),
+    multiple_of: int = 1,
+) -> int:
+    """Smallest batch-ladder entry >= n, additionally divisible by
+    ``multiple_of`` (the engine's dp mesh size: every device must get an
+    equal shard, so sharded engines pad the batch to a dp multiple and
+    crop back after the forward)."""
+    m = max(int(multiple_of), 1)
     for b in ladder:
-        if b >= n:
+        if b >= n and b % m == 0:
             return b
-    return math.ceil(n / 64) * 64
+    ceil64 = math.ceil(n / 64) * 64
+    if ceil64 % m == 0:
+        # above the ladder with a dp that divides the 64-ceil (1, any
+        # power of two <= 64): keep the legacy quantization — a dp=4
+        # batch of 130 pads to 192, not a geometric 256
+        return ceil64
+    # no ladder entry or 64-ceil divides by m (non-power-of-two dp, or
+    # tiny n below the first divisible rung): geometric quantization on
+    # dp units — log-many buckets, <2x padding (same scheme as
+    # bucket_dim's odd-divisor fallback). A 64-ceil here would pad a
+    # 1-image request on dp=3 to 66.
+    units = math.ceil(n / m)
+    return m * (1 << max(0, math.ceil(math.log2(units))))
 
 
 def pad_to(x: np.ndarray, target_hw: tuple[int, int], axes: tuple[int, int] = (1, 2)) -> np.ndarray:

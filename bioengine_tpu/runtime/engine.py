@@ -18,6 +18,18 @@ recycled into its output, and host chunks assembled in reusable
 per-(bucket, dtype) staging buffers instead of fresh ``pad_to`` +
 ``np.concatenate`` copies. ``predict_serial`` keeps the strictly
 serial path as the parity baseline; both produce bit-identical output.
+
+Multi-chip serving: an engine constructed with the replica's leased
+chip group (``devices=[...]`` or ``device_ids=[...]``) builds a named
+mesh over it (parallel/mesh.py) and runs every bucketed forward
+sharded — the batch split over the ``dp`` axis (params replicated),
+optionally the weights Megatron-sharded over a ``tp`` axis
+(parallel/tensor_parallel.py rules) for models whose matrices outgrow
+one chip's HBM. Batches are padded to a dp multiple
+(buckets.bucket_batch ``multiple_of``) so every shard is equal, and
+compiled programs are cached per (bucket, mesh-shape). A 1-chip engine
+takes exactly the legacy single-device path, so its results are
+bit-identical to pre-mesh behavior.
 """
 
 from __future__ import annotations
@@ -26,11 +38,12 @@ import dataclasses
 import itertools
 import time
 import warnings
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from bioengine_tpu.runtime.buckets import (
     DEFAULT_LADDER,
@@ -50,6 +63,61 @@ from bioengine_tpu.runtime.program_cache import (
     CompiledProgramCache,
     default_program_cache,
 )
+
+
+def resolve_devices(
+    device_ids: Optional[Sequence[int]],
+) -> list[jax.Device]:
+    """Map a replica's leased chip ids onto jax devices.
+
+    Matches by ``Device.id``. When NONE of the lease ids exist AND the
+    local backend is the CPU host platform (a TpuTopology-numbered
+    lease exercised on the forced host-device test mesh), falls back to
+    the first ``len(device_ids)`` local devices so the mesh WIDTH — the
+    property the lease actually encodes — is preserved. On a real
+    accelerator backend ANY unmatched id raises: silently remapping
+    would stack disjoint leases onto the same chips while the
+    controller's accounting shows them separate."""
+    local = list(jax.local_devices())
+    if not device_ids:
+        return local[:1]
+    by_id = {d.id: d for d in local}
+    matched = [i for i in device_ids if i in by_id]
+    if len(matched) == len(device_ids):
+        return [by_id[i] for i in device_ids]
+    if matched:
+        raise ValueError(
+            f"lease ids {list(device_ids)} only partially match local "
+            f"device ids {sorted(by_id)} — chip numbering conflict"
+        )
+    if any(d.platform != "cpu" for d in local):
+        raise ValueError(
+            f"lease ids {list(device_ids)} match no local device ids "
+            f"{sorted(by_id)} on a {local[0].platform} backend — "
+            "refusing to remap (disjoint leases would stack onto the "
+            "same chips); the width-preserving fallback is CPU-only"
+        )
+    if len(device_ids) > len(local):
+        raise ValueError(
+            f"lease names {len(device_ids)} chips but only "
+            f"{len(local)} local devices exist"
+        )
+    return local[: len(device_ids)]
+
+
+def mesh_cache_tag(dp: int, tp: int = 1) -> str:
+    """The ONE definition of mesh-shape identity in cache keys:
+    compiled programs (InferenceEngine._mesh_key) and model-runner
+    pipeline entries both encode the chip-group shape with this —
+    '1dev' for the legacy single-device path, 'dp4', 'dp2xtp2'. Two
+    engines with different shapes must never share an executable or
+    co-batch. Program-cache keys further qualify this with the concrete
+    device group (InferenceEngine._placement_key): same shape on
+    different chips is a different executable."""
+    dp, tp = max(int(dp), 1), max(int(tp), 1)
+    if dp * tp == 1:
+        return "1dev"
+    return f"dp{dp}" + (f"xtp{tp}" if tp > 1 else "")
 
 
 @dataclasses.dataclass
@@ -113,6 +181,10 @@ class InferenceEngine:
         config: Optional[EngineConfig] = None,
         cache: Optional[CompiledProgramCache] = None,
         device: Optional[jax.Device] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+        device_ids: Optional[Sequence[int]] = None,
+        tp: int = 1,
+        tp_rules: Optional[Sequence] = None,
     ):
         self.model_id = model_id
         self.apply_fn = apply_fn
@@ -120,11 +192,117 @@ class InferenceEngine:
         self.z_divisor = z_divisor
         self.config = config or EngineConfig()
         self.cache = cache if cache is not None else default_program_cache
-        self.device = device or jax.devices()[0]
-        self.params = jax.device_put(params, self.device)
+        # ---- device group -> mesh -------------------------------------------
+        # precedence: explicit device objects > lease ids > legacy single
+        # ``device`` kwarg > jax.devices()[0]
+        if devices is not None:
+            self.devices = list(devices)
+        elif device_ids:
+            self.devices = resolve_devices(list(device_ids))
+        else:
+            self.devices = [device or jax.devices()[0]]
+        n = len(self.devices)
+        self.tp = max(int(tp), 1)
+        if n % self.tp:
+            raise ValueError(
+                f"tp={self.tp} does not divide the {n}-chip group"
+            )
+        if self.tp > 1 and not tp_rules:
+            # tp exists to SHARD the weights; silently replicating them
+            # instead would hand a caller who asked for tp (because the
+            # params outgrow one chip's HBM) a full copy per chip and an
+            # OOM with mesh_shape still claiming a tp axis
+            raise ValueError(
+                f"tp={self.tp} requested without tp_rules — pass GSPMD "
+                "rules (e.g. parallel.tensor_parallel.VIT_TP_RULES) or "
+                "drop the tp axis"
+            )
+        self.dp = n // self.tp
+        self.device = self.devices[0]
+        if n > 1:
+            from bioengine_tpu.parallel.mesh import make_mesh
+
+            axes = {"dp": self.dp}
+            if self.tp > 1:
+                axes["tp"] = self.tp
+            self.mesh = make_mesh(axes, self.devices)
+        else:
+            # the degenerate 1-chip "mesh" IS the legacy single-device
+            # path — same placement, same programs, bit-identical output
+            self.mesh = None
+        if self.mesh is not None and self.tp > 1 and tp_rules:
+            from bioengine_tpu.parallel.tensor_parallel import shard_params
+
+            self.params, self._param_shardings = shard_params(
+                self.mesh, params, tp_rules
+            )
+        elif self.mesh is not None:
+            self._param_shardings = NamedSharding(self.mesh, P())
+            self.params = jax.device_put(params, self._param_shardings)
+        else:
+            self._param_shardings = None
+            self.params = jax.device_put(params, self.device)
         self.pipeline_stats = PipelineStats(depth=self.config.pipeline_depth)
         self._staging_pool = StagingPool()
         self._dispatcher = DispatchExecutor(f"dispatch-{model_id}")
+
+    # ---- mesh introspection -------------------------------------------------
+
+    @property
+    def mesh_shape(self) -> Optional[dict[str, int]]:
+        """{"dp": N[, "tp": M]} for sharded engines, None on 1 chip."""
+        return dict(self.mesh.shape) if self.mesh is not None else None
+
+    @property
+    def _mesh_key(self) -> str:
+        # mesh is None exactly when dp*tp == 1, where mesh_cache_tag
+        # already returns the legacy "1dev" tag
+        return mesh_cache_tag(self.dp, self.tp)
+
+    @property
+    def _placement_key(self) -> str:
+        """Program identity: mesh shape AND the concrete device group.
+        The shape tag alone is not enough for a shared program cache —
+        two same-width engines over disjoint chip groups (replica A on
+        chips 0-3, replica B on 4-7 in one 8-chip host process) build
+        unequal Meshes, so A's warmed executable is a silent
+        retrace+recompile inside B's first hot request."""
+        ids = ",".join(str(d.id) for d in self.devices)
+        return f"{self._mesh_key}@{ids}"
+
+    def _batch_sharding(self, ndim: int) -> NamedSharding:
+        """Leading dim over ``dp``, everything else replicated (tp
+        sharding lives in the params; GSPMD propagates it)."""
+        return NamedSharding(self.mesh, P("dp", *([None] * (ndim - 1))))
+
+    def _put(self, host: np.ndarray):
+        """Place a staged host batch: single-device put on 1 chip,
+        dp-sharded scatter on a mesh. The batch dim is always a dp
+        multiple (bucket_batch ``multiple_of``), so shards are equal."""
+        if self.mesh is None:
+            return jax.device_put(host, self.device)
+        return jax.device_put(host, self._batch_sharding(host.ndim))
+
+    def describe(self) -> dict:
+        """Mesh + per-chip utilization for Replica.describe /
+        get_app_status (memory_stats is best-effort: the CPU backend
+        has none)."""
+        per_chip = {}
+        for d in self.devices:
+            entry: dict[str, Any] = {"platform": d.platform}
+            try:
+                stats = d.memory_stats() or {}
+                entry["bytes_in_use"] = stats.get("bytes_in_use")
+                entry["bytes_limit"] = stats.get("bytes_limit")
+            except Exception:  # noqa: BLE001 — stats never break status
+                pass
+            per_chip[str(d.id)] = entry
+        return {
+            "device_ids": [d.id for d in self.devices],
+            "n_devices": len(self.devices),
+            "mesh": self.mesh_shape,
+            "per_chip": per_chip,
+        }
 
     def close(self) -> None:
         """Release the async dispatch thread (idempotent)."""
@@ -142,7 +320,17 @@ class InferenceEngine:
 
     def _program(self, shape: tuple[int, ...], dtype) -> Callable:
         donate = bool(self.config.donate_buffers)
-        key = (self.model_id, *shape, np.dtype(dtype).name, donate)
+        # the mesh shape AND device group are part of program identity:
+        # the same bucket compiled for dp=4 is a different executable
+        # (sharded layouts, SPMD collectives) than the 1-chip program,
+        # and the same dp=4 shape on a different chip group is a
+        # different placement — a shared cache serving several engines
+        # must never mix any of them (each entry's warmup must run on
+        # its own engine's placement, see build() below)
+        key = (
+            self.model_id, *shape, np.dtype(dtype).name, donate,
+            self._placement_key,
+        )
 
         def build():
             fn = (
@@ -152,14 +340,14 @@ class InferenceEngine:
             )
             # Trigger compilation now so the first request doesn't pay it
             # inside the hot path accounting. The dummy must be COMMITTED
-            # to the engine's device — the hot path feeds
-            # device_put(x, self.device) arrays, and an uncommitted
-            # warmup arg compiles a different executable (the hot path
-            # would silently recompile on its first call). Donation is
-            # best-effort: XLA warns when no output can alias the input
-            # (e.g. a global-output model) and runs undonated — not
-            # actionable.
-            dummy = jax.device_put(jnp.zeros(shape, dtype), self.device)
+            # with the hot path's placement — the hot path feeds
+            # ``_put`` arrays (single-device or dp-sharded), and a
+            # differently-placed warmup arg compiles a different
+            # executable (the hot path would silently recompile on its
+            # first call). Donation is best-effort: XLA warns when no
+            # output can alias the input (e.g. a global-output model)
+            # and runs undonated — not actionable.
+            dummy = self._put(np.zeros(shape, np.dtype(dtype)))
             with warnings.catch_warnings():
                 warnings.filterwarnings(
                     "ignore", message=".*donated buffers.*"
@@ -171,7 +359,10 @@ class InferenceEngine:
 
     def warmup(self, shapes: list[tuple[int, ...]], dtype=np.float32):
         for shape in shapes:
-            self._program(tuple(shape), dtype)
+            # normalize the batch dim exactly like the hot path does —
+            # a dp-sharded _put of a non-dp-divisible dummy would raise
+            B, *rest = shape
+            self._program((bucket_batch(B, multiple_of=self.dp), *rest), dtype)
 
     # ---- prediction ---------------------------------------------------------
 
@@ -262,14 +453,12 @@ class InferenceEngine:
             bucket_dim(size, spec.ladder, spec.divisor)
             for size, spec in zip(spatial, specs)
         )
-        bb = bucket_batch(B)
+        bb = bucket_batch(B, multiple_of=self.dp)
         staged = self._staging_pool.acquire((bb, *buckets, C), x.dtype)
         try:
             fill_bucketed(staged, x)
             program = self._program(staged.shape, staged.dtype)
-            out = np.asarray(
-                program(self.params, jax.device_put(staged, self.device))
-            )
+            out = np.asarray(program(self.params, self._put(staged)))
         finally:
             self._staging_pool.release(staged)
         out = out[:B]
@@ -400,7 +589,10 @@ class InferenceEngine:
             b, i0, i1 = desc
             n = i1 - i0
             item = images[b]
-            buf = pool.acquire((bucket_batch(n), *buckets, C), images.dtype)
+            buf = pool.acquire(
+                (bucket_batch(n, multiple_of=self.dp), *buckets, C),
+                images.dtype,
+            )
             tile_region = tuple(slice(0, t) for t in tsizes)
             for j, start in enumerate(coords[i0:i1]):
                 sl = tuple(
@@ -421,7 +613,10 @@ class InferenceEngine:
         def dispatch(desc, staged):
             buf, n = staged
             t0 = time.perf_counter()
-            dev = jax.device_put(buf, self.device)
+            # staged host chunks become sharded arrays on a mesh engine
+            # (single-device put on 1 chip) — staging/dispatch/stitch
+            # semantics, donation, and double buffering are unchanged
+            dev = self._put(buf)
             t1 = time.perf_counter()
             program = self._program(buf.shape, buf.dtype)
             out = program(self.params, dev)

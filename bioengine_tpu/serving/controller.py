@@ -899,12 +899,41 @@ class ServeController:
             "status": app.status,
             "created_at": app.created_at,
             "deployments": {
-                name: {
-                    "num_replicas": len(replicas),
-                    "replicas": [r.describe() for r in replicas],
-                    "queue_depth": self._queue_depth.get((app_id, name), 0),
-                }
+                name: self._describe_deployment(app_id, name, replicas)
                 for name, replicas in app.replicas.items()
+            },
+        }
+
+    def _describe_deployment(self, app_id, name, replicas) -> dict:
+        """Per-deployment status: replica describes plus the load
+        rollup least-loaded routing acts on — router queue depth,
+        outstanding + parked calls, and each replica's mesh shape, so
+        a sharded replica that hogs traffic (or idles its chips) is
+        visible from one status call."""
+        described = [r.describe() for r in replicas]
+        # RemoteReplica.describe deliberately omits queued_requests
+        # (the semaphore queue lives host-side): a missing key means
+        # UNKNOWN, so the rollup reports None rather than coercing to
+        # 0 and faking an idle queue to least-loaded routing decisions
+        queued = [d.get("queued_requests") for d in described]
+        return {
+            "num_replicas": len(replicas),
+            "replicas": described,
+            "queue_depth": self._queue_depth.get((app_id, name), 0),
+            "outstanding_calls": sum(
+                d.get("ongoing_requests", 0) for d in described
+            ),
+            "queued_calls": (
+                sum(queued) if all(q is not None for q in queued) else None
+            ),
+            "avg_load": round(
+                sum(d.get("load", 0.0) for d in described) / len(described),
+                4,
+            ) if described else 0.0,
+            "mesh_shapes": {
+                d["replica_id"]: (d.get("mesh") or {}).get("mesh_shape")
+                for d in described
+                if d.get("mesh")
             },
         }
 

@@ -234,6 +234,10 @@ class RemoteReplica:
             "device_ids": self.device_ids,
             "host_id": self.host_id,
             "ongoing_requests": self._ongoing,
+            # no queued_requests key: the semaphore queue lives in the
+            # host-side Replica (visible via the host's get_status /
+            # describe) — reporting 0 here would fake an idle queue and
+            # the controller rollup treats a missing key as unknown
             "total_requests": self._total_requests,
             "load": self.load,
             "uptime_seconds": time.time() - self.started_at,

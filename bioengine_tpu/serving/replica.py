@@ -69,6 +69,7 @@ class Replica:
         self.instance: Any = None
         self._semaphore = asyncio.Semaphore(max_ongoing_requests)
         self._ongoing = 0
+        self._queued = 0          # callers parked on the semaphore
         self._idle_event = asyncio.Event()
         self._idle_event.set()
         self._total_requests = 0
@@ -96,6 +97,23 @@ class Replica:
             self.state = ReplicaState.INITIALIZING
             self._log("constructing deployment instance")
             self.instance = self._instance_factory()
+            if self.device_ids:
+                # hand the leased chip group to the instance BEFORE
+                # async_init so mesh-aware deployments (model-runner's
+                # RuntimeDeployment) can build their device mesh over
+                # exactly the chips this replica owns instead of
+                # defaulting to jax.devices()[0]
+                try:
+                    self.instance.bioengine_device_ids = list(self.device_ids)
+                except Exception as e:  # noqa: BLE001 — slots/frozen instances opt out
+                    # not fatal (the instance may not be mesh-aware), but
+                    # a K-chip lease that can't reach the instance means
+                    # K-1 idle chips — make that diagnosable
+                    self._log(
+                        "could not inject device lease "
+                        f"{list(self.device_ids)} into instance ({e}); "
+                        "replica will run single-device"
+                    )
             if hasattr(self.instance, "async_init"):
                 await _maybe_await(self.instance.async_init())
             self._init_done = True
@@ -210,7 +228,12 @@ class Replica:
             raise AttributeError(
                 f"{self.deployment_name} has no method '{method}'"
             )
-        async with self._semaphore:
+        self._queued += 1
+        try:
+            await self._semaphore.acquire()
+        finally:
+            self._queued -= 1
+        try:
             # re-check after the (possibly long) semaphore wait: a drain
             # or stop that happened while this call was parked must not
             # let it execute against a torn-down instance — the typed
@@ -228,6 +251,8 @@ class Replica:
                 self._ongoing -= 1
                 if self._ongoing == 0:
                     self._idle_event.set()
+        finally:
+            self._semaphore.release()
 
     async def call_bounded(
         self,
@@ -255,6 +280,7 @@ class Replica:
             "state": self.state.value,
             "device_ids": self.device_ids,
             "ongoing_requests": self._ongoing,
+            "queued_requests": self._queued,
             "total_requests": self._total_requests,
             "load": self.load,
             "uptime_seconds": time.time() - self.started_at,
@@ -271,6 +297,15 @@ class Replica:
                 d["pipeline_stats"] = stats_fn()
             except Exception as e:  # noqa: BLE001 — stats never break health
                 d["pipeline_stats"] = {"error": str(e)}
+        # mesh-aware deployments report how their leased chip group is
+        # actually used (mesh shape + per-chip utilization) so the
+        # controller can see sharding health, not just chip accounting
+        mesh_fn = getattr(self.instance, "mesh_info", None)
+        if callable(mesh_fn):
+            try:
+                d["mesh"] = mesh_fn()
+            except Exception as e:  # noqa: BLE001 — stats never break health
+                d["mesh"] = {"error": str(e)}
         # deployments that hold their own control-plane connection
         # (data proxies, federated apps) expose ``rpc_stats()`` — the
         # transport counters ride the same describe path so

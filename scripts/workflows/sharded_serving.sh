@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# CI job: sharded-serving leg of the multichip dryrun — fails fast on
+# sharding regressions without waiting for the slow suite or a TPU.
+#
+# Two checks on a forced 4-virtual-device CPU mesh (the same trick as
+# tests/conftest.py and the MULTICHIP dryruns):
+#   1. the full multichip dryrun (__graft_entry__.dryrun_multichip),
+#      which now ends with a sharded-serving engine phase: a dp-mesh
+#      InferenceEngine forward checked for parity against the 1-chip
+#      engine;
+#   2. the dedicated engine test file (1-chip bit-identity, dp=4
+#      tolerance on planar + tiled paths, dp batch padding, mesh-keyed
+#      program cache, lease accounting).
+#
+# Run locally from the repo root:  scripts/workflows/sharded_serving.sh
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=4"
+
+echo "sharded-serving: multichip dryrun (4-device CPU mesh)"
+python __graft_entry__.py 4
+
+echo "sharded-serving: engine parity + accounting tests"
+python -m pytest tests/test_sharded_engine.py -q -p no:cacheprovider

@@ -78,6 +78,46 @@ def test_normal_run_prints_one_parsed_line():
     assert po["pipeline_stats"]["chunks"] > 0
 
 
+def test_sharded_serving_stage_schema():
+    """Pin the sharded_serving artifact schema: 1-chip vs dp-K engine
+    throughput on the same bucketed batch workload, the dp scaling
+    efficiency, and the parity check. On CPU the stage spawns its own
+    --sharded-worker subprocess with 4 forced virtual host devices (the
+    flag stays out of the worker every other stage is measured in), so
+    the dp leg always runs; throughput numbers there are core-bound and
+    informational — the schema plus parity are the contract (the TPU
+    round supplies the scaling number)."""
+    proc, lines = _run(
+        {
+            "BENCH_CONFIGS": "sharded_serving",
+            "BENCH_DEADLINE": "170",
+        },
+        timeout=200.0,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    st = json.loads(lines[-1])["extra"]["sharded_serving"]
+    assert st["ok"], st
+    for key in (
+        "batch",
+        "image_hw",
+        "n_devices",
+        "images_per_sec_1chip",
+        "images_per_sec_dp",
+        "speedup",
+        "dp_scaling_efficiency",
+        "mesh",
+        "parity_max_abs_err",
+        "parity_ok",
+    ):
+        assert key in st, key
+    assert st["n_devices"] == 4
+    assert st["mesh"] == {"dp": 4}
+    assert st["images_per_sec_1chip"] > 0
+    assert st["images_per_sec_dp"] > 0
+    # the two engines ran the same inputs: outputs must agree
+    assert st["parity_ok"], st["parity_max_abs_err"]
+
+
 def test_rpc_transport_stage_schema():
     """Pin the rpc_transport artifact schema: three paths (legacy /
     zero-copy oob / shm), per-size e2e + codec round-trip numbers, the
