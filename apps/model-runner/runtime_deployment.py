@@ -33,6 +33,7 @@ import numpy as np
 
 from bioengine_tpu.rpc import schema_method
 from bioengine_tpu.runtime.engine import EngineConfig, InferenceEngine
+from bioengine_tpu.utils import tracing
 from bioengine_tpu.runtime.rdf import (
     apply_processing,
     from_nhwc,
@@ -236,9 +237,10 @@ class Pipeline:
         without spawning a thread per request via asyncio.to_thread.
         The torch fallback has no dispatch thread; it keeps to_thread."""
         if self.backend == "xla":
-            return await asyncio.wrap_future(
-                self.engine.submit(self.predict, inputs)
-            )
+            # carry a sampled trace context onto the dispatch thread so
+            # engine.predict's stage span lands in the request's tree
+            fn = tracing.carry(tracing.current_trace(), self.predict)
+            return await asyncio.wrap_future(self.engine.submit(fn, inputs))
         return await asyncio.to_thread(self.predict, inputs)
 
     def pipeline_stats(self) -> dict:
@@ -259,7 +261,7 @@ class Pipeline:
         """Run the packaged test tensors through the pipeline and compare
         against the expected outputs (the reference delegates this to
         bioimageio.core test_model, ref runtime_deployment.py:86-156)."""
-        t0 = time.time()
+        t0 = time.monotonic()
         test_in = self._load_test_arrays("inputs", "test_inputs")
         if test_in is None:
             spec = self.input_spec
@@ -284,7 +286,7 @@ class Pipeline:
             "synthesized_input": synthesized,
             "input_shape": list(np.asarray(test_in).shape),
             "output_shape": list(output.shape),
-            "duration_seconds": round(time.time() - t0, 3),
+            "duration_seconds": round(time.monotonic() - t0, 3),
         }
         expected = self._load_test_arrays("outputs", "test_outputs")
         if expected is not None and not synthesized:
@@ -369,7 +371,8 @@ class RuntimeDeployment:
         pipeline = payloads[0][0]
         arrays = [a for _, a in payloads]
         sizes = [len(a) for a in arrays]
-        merged = np.concatenate(arrays, axis=0)
+        with tracing.trace_span("batch.assemble", requests=len(arrays)):
+            merged = np.concatenate(arrays, axis=0)
         result = await pipeline.predict_async(merged)
         out_name, y = next(iter(result.items()))
         outs = []
@@ -519,7 +522,7 @@ class RuntimeDeployment:
         batch-first and whose per-item shapes match ride one batched
         engine call (continuous batching); anything else takes the
         direct path unchanged."""
-        t0 = time.time()
+        t0 = time.monotonic()
         try:
             pipeline = await self._get_pipeline(
                 rdf_path, weights_format, default_blocksize_parameter
@@ -545,7 +548,7 @@ class RuntimeDeployment:
                 result = await pipeline.predict_async(array)
         except Exception as e:
             raise _normalize_oom(e) from e
-        ms = (time.time() - t0) * 1000
+        ms = (time.monotonic() - t0) * 1000
         return {
             **result,
             "_meta": {

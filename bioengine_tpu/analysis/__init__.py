@@ -10,6 +10,7 @@ violations inside jitted code*.  This package catches both statically:
   registry, ``# bioengine: ignore[RULE]`` suppressions.
 - :mod:`bioengine_tpu.analysis.async_rules` — BE-ASYNC-* rules.
 - :mod:`bioengine_tpu.analysis.jax_rules` — BE-JAX-* rules.
+- :mod:`bioengine_tpu.analysis.obs_rules` — BE-OBS-* rules.
 - :mod:`bioengine_tpu.analysis.baseline` — checked-in baseline so
   pre-existing, justified findings don't block CI.
 
@@ -35,6 +36,7 @@ from bioengine_tpu.analysis.baseline import (
 # Importing the rule modules registers their rules with the registry.
 from bioengine_tpu.analysis import async_rules as _async_rules  # noqa: F401
 from bioengine_tpu.analysis import jax_rules as _jax_rules  # noqa: F401
+from bioengine_tpu.analysis import obs_rules as _obs_rules  # noqa: F401
 
 __all__ = [
     "Finding",

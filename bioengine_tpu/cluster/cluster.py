@@ -149,6 +149,9 @@ class TpuCluster:
         for workload in list(self.state.pending()):
             # pending items are cleared by the serving controller once
             # placed; stale ones older than an hour are dropped here.
+            # submitted_at is a displayed wall timestamp; an hour-scale
+            # staleness gate tolerates NTP slew.
+            # bioengine: ignore[BE-OBS-001]
             if time.time() - workload.submitted_at > 3600:
                 self.state.remove_pending(workload.workload_id)
         return actions

@@ -66,7 +66,7 @@ class Provisioner(abc.ABC):
     def __init__(self, policy: Optional[ScalingPolicy] = None):
         self.policy = policy or ScalingPolicy()
         self.workers: dict[str, WorkerRecord] = {}
-        self._last_scale_up = 0.0
+        self._last_scale_up = float("-inf")  # monotonic clock
         self.join_server_url: Optional[str] = None
         self.join_token: Optional[str] = None
         self.logger = create_logger(self.__class__.__name__, log_file="off")
@@ -110,7 +110,7 @@ class Provisioner(abc.ABC):
         # Scale up: pending workloads + cooldown elapsed + below cap.
         if (
             pending
-            and time.time() - self._last_scale_up > self.policy.cooldown_seconds
+            and time.monotonic() - self._last_scale_up > self.policy.cooldown_seconds
             and len(active) < self.policy.max_workers
         ):
             item = pending[0]
@@ -127,7 +127,7 @@ class Provisioner(abc.ABC):
                 resources=resources,
                 worker_tag=worker_tag,
             )
-            self._last_scale_up = time.time()
+            self._last_scale_up = time.monotonic()
             up.append(worker_id)
             self.logger.info(
                 f"scale-up {worker_id} (job {job_id}) for pending "

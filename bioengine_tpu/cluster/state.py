@@ -141,10 +141,14 @@ class ClusterState:
                 {
                     "workload_id": p.workload_id,
                     "resources": p.resources,
+                    # display ages against displayed wall timestamps —
+                    # not SLO measurements
+                    # bioengine: ignore[BE-OBS-001]
                     "age_seconds": time.time() - p.submitted_at,
                 }
                 for p in self._pending.values()
             ],
+            # bioengine: ignore[BE-OBS-001]
             "uptime_seconds": time.time() - self.started_at,
         }
 

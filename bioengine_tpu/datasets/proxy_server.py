@@ -58,7 +58,7 @@ class DatasetRegistry:
     def __init__(self, data_dir: Path):
         self.data_dir = Path(data_dir)
         self.datasets: dict[str, dict] = {}
-        self.last_scan = 0.0
+        self.last_scan = float("-inf")  # monotonic clock
 
     def scan(self) -> None:
         found = {}
@@ -77,10 +77,10 @@ class DatasetRegistry:
                     "authorized_users": manifest.get("authorized_users", []),
                 }
         self.datasets = found
-        self.last_scan = time.time()
+        self.last_scan = time.monotonic()
 
     def maybe_rescan(self) -> None:
-        if time.time() - self.last_scan > MANIFEST_RELOAD_SECONDS:
+        if time.monotonic() - self.last_scan > MANIFEST_RELOAD_SECONDS:
             self.scan()
 
 
@@ -118,7 +118,7 @@ class DatasetsServer:
         if not token:
             return await _anonymous_validator("")
         cached = self._token_cache.get(token)
-        if cached is not None and time.time() - cached[1] < TOKEN_CACHE_TTL_SECONDS:
+        if cached is not None and time.monotonic() - cached[1] < TOKEN_CACHE_TTL_SECONDS:
             self._token_cache.move_to_end(token)
             return cached[0]
         try:
@@ -127,7 +127,7 @@ class DatasetsServer:
         except PermissionError as e:
             self._token_cache.pop(token, None)
             raise web.HTTPUnauthorized(reason=str(e))
-        self._token_cache[token] = (context, time.time())
+        self._token_cache[token] = (context, time.monotonic())
         while len(self._token_cache) > TOKEN_CACHE_SIZE:
             self._token_cache.popitem(last=False)
         return context

@@ -23,6 +23,7 @@ from bioengine_tpu.rpc.transport import (
     attach_store_by_name,
 )
 from bioengine_tpu.testing import faults
+from bioengine_tpu.utils import tracing
 from bioengine_tpu.utils.backoff import full_jitter_delay
 from bioengine_tpu.utils.logger import create_logger
 from bioengine_tpu.utils.tasks import spawn_supervised
@@ -76,7 +77,9 @@ class ServerConnection:
         # capabilities declared at handshake; [] forces pure-legacy
         # framing in BOTH directions (bench baseline, interop tests)
         self.protocols = (
-            [protocol.PROTO_OOB1] if protocols is None else list(protocols)
+            [protocol.PROTO_OOB1, protocol.PROTO_TRACE1]
+            if protocols is None
+            else list(protocols)
         )
         self.auto_reconnect = auto_reconnect
         self.reconnect_max_backoff_s = reconnect_max_backoff_s
@@ -129,6 +132,11 @@ class ServerConnection:
         self.user_id = welcome["user_id"]
         self.codec.oob = protocol.PROTO_OOB1 in self.protocols and (
             protocol.PROTO_OOB1 in welcome.get("protocols", [])
+        )
+        # trace fields ride the CALL envelope only when BOTH sides
+        # advertise trace1 — a legacy peer never sees them on the wire
+        self.codec.trace = protocol.PROTO_TRACE1 in self.protocols and (
+            protocol.PROTO_TRACE1 in welcome.get("protocols", [])
         )
         self._reader_task = asyncio.create_task(self._read_loop())
         if self.codec.oob and isinstance(welcome.get("shm"), dict):
@@ -243,6 +251,11 @@ class ServerConnection:
                     continue  # mid-reassembly chunk
                 t = data.get("t")
                 if t in (protocol.RESULT, protocol.ERROR):
+                    if data.get("spans"):
+                        # sampled-trace spans recorded by the peer while
+                        # serving our call — fold into the local buffer
+                        # so one process holds the whole tree
+                        tracing.absorb_spans(data["spans"])
                     fut = self._pending.pop(data.get("call_id", ""), None)
                     if fut and not fut.done():
                         if t == protocol.RESULT:
@@ -374,19 +387,38 @@ class ServerConnection:
 
     async def _handle_incoming_call(self, msg: dict) -> None:
         """The server is routing another client's call to one of OUR
-        registered services."""
+        registered services. A sampled trace context on the CALL is
+        activated around the handler (local spans chain under the
+        caller's span) and the spans it closes ship back on the
+        RESULT/ERROR frame."""
         assert self._ws is not None
+        ctx = token = None
+        if self.codec.trace and isinstance(msg.get("trace"), dict):
+            ctx = tracing.TraceContext.from_wire(msg["trace"])
+            token = tracing.activate(ctx)
+
+        def _spans() -> dict:
+            if ctx is not None and ctx.collector:
+                return {"spans": ctx.collector}
+            return {}
+
         try:
             service = self._local_services[msg["service_id"]]
             fn = service[msg["method"]]
-            result = fn(*msg.get("args", []), **msg.get("kwargs", {}))
-            if asyncio.iscoroutine(result):
-                result = await result
+            with tracing.trace_span(
+                "rpc.handle",
+                service=msg["service_id"],
+                method=msg["method"],
+            ):
+                result = fn(*msg.get("args", []), **msg.get("kwargs", {}))
+                if asyncio.iscoroutine(result):
+                    result = await result
             await self._send_msg(
                 {
                     "t": protocol.RESULT,
                     "call_id": msg.get("call_id"),
                     "result": result,
+                    **_spans(),
                 }
             )
         except Exception as e:
@@ -395,9 +427,12 @@ class ServerConnection:
                     "t": protocol.ERROR,
                     "call_id": msg.get("call_id"),
                     "error": e,
+                    **_spans(),
                 }
             )
         finally:
+            if token is not None:
+                tracing.deactivate(token)
             # args decoded from shm refs die with the handler — let the
             # store reclaim their blocks
             self.codec.drain_pins()
@@ -442,15 +477,17 @@ class ServerConnection:
         raise KeyError(f"Service '{service_id}' not found")
 
     async def call(self, service_id: str, method: str, *args, **kwargs) -> Any:
-        return await self._request(
-            {
-                "t": protocol.CALL,
-                "service_id": service_id,
-                "method": method,
-                "args": list(args),
-                "kwargs": kwargs,
-            }
-        )
+        msg = {
+            "t": protocol.CALL,
+            "service_id": service_id,
+            "method": method,
+            "args": list(args),
+            "kwargs": kwargs,
+        }
+        ctx = tracing.current_trace()
+        if self.codec.trace and ctx is not None and ctx.sampled:
+            msg["trace"] = ctx.to_wire()
+        return await self._request(msg)
 
     async def generate_token(self, config: Optional[dict] = None) -> str:
         config = config or {}

@@ -67,6 +67,7 @@ SHM_ACK = "shm_ack"            # client proves it mapped the shared store
 OOB_MAGIC = b"BEF1"            # out-of-band scatter-gather frame
 CHUNK_MAGIC = b"BEC1"          # one chunk of an oversized frame
 PROTO_OOB1 = "oob1"            # negotiated capability name
+PROTO_TRACE1 = "trace1"        # request-trace fields on CALL/RESULT
 
 EXT_NDARRAY = 1                # legacy inline array (double-packed)
 EXT_EXCEPTION = 2

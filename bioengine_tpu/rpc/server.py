@@ -32,6 +32,7 @@ from bioengine_tpu.rpc import protocol
 from bioengine_tpu.rpc.schema import extract_schema
 from bioengine_tpu.rpc.transport import Codec, RpcStats, TransportConfig
 from bioengine_tpu.testing import faults
+from bioengine_tpu.utils import metrics, tracing
 from bioengine_tpu.utils.logger import create_logger
 from bioengine_tpu.utils.tasks import spawn_supervised
 
@@ -165,6 +166,10 @@ class RpcServer:
         app.router.add_get("/ws", self._handle_ws)
         app.router.add_get("/health/liveness", self._handle_health)
         app.router.add_get("/services", self._handle_list_http)
+        # Prometheus scrape surface: the process-wide metrics registry
+        # (request latency histograms, transport counters, serving
+        # gauges) in text exposition format — docs/observability.md
+        app.router.add_get("/metrics", self._handle_metrics)
         # JSON-over-HTTP bridge: what browser frontends use (the
         # reference's frontends call Hypha services from JS, ref
         # apps/cellpose-finetuning/frontend/index.html; here the bridge
@@ -364,9 +369,12 @@ class RpcServer:
             fn = entry.methods.get(method)
             if fn is None:
                 raise AttributeError(f"{full_id} has no method '{method}'")
-            result = fn(*args, **kwargs)
-            if asyncio.iscoroutine(result):
-                result = await result
+            with tracing.trace_span(
+                "rpc.dispatch", service=full_id, method=method
+            ):
+                result = fn(*args, **kwargs)
+                if asyncio.iscoroutine(result):
+                    result = await result
             return result
         # remote provider: forward over its websocket
         ws = self._clients.get(entry.owner_client)
@@ -376,20 +384,27 @@ class RpcServer:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[call_id] = fut
         self._pending_owner[call_id] = entry.owner_client
+        msg = {
+            "t": protocol.CALL,
+            "call_id": call_id,
+            "service_id": full_id,
+            "method": method,
+            "args": list(args),
+            "kwargs": kwargs,
+        }
+        # carry the caller's sampled trace context to the provider —
+        # only when that provider declared trace1 at its handshake
+        # (legacy peers see a byte-identical CALL)
+        codec = self._client_codecs.get(entry.owner_client)
+        ctx = tracing.current_trace()
+        if codec is not None and codec.trace and ctx is not None and ctx.sampled:
+            msg["trace"] = ctx.to_wire()
         try:
-            await self._send(
-                ws,
-                self._client_codecs.get(entry.owner_client),
-                {
-                    "t": protocol.CALL,
-                    "call_id": call_id,
-                    "service_id": full_id,
-                    "method": method,
-                    "args": list(args),
-                    "kwargs": kwargs,
-                },
-            )
-            return await asyncio.wait_for(fut, timeout)
+            with tracing.trace_span(
+                "rpc.call", service=full_id, method=method
+            ):
+                await self._send(ws, codec, msg)
+                return await asyncio.wait_for(fut, timeout)
         finally:
             self._pending.pop(call_id, None)
             self._pending_owner.pop(call_id, None)
@@ -410,6 +425,14 @@ class RpcServer:
 
     async def _handle_health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok", "services": len(self._services)})
+
+    async def _handle_metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            body=metrics.render_prometheus().encode(),
+            headers={
+                "Content-Type": "text/plain; version=0.0.4; charset=utf-8"
+            },
+        )
 
     async def _handle_list_http(self, request: web.Request) -> web.Response:
         return web.json_response(self.list_services())
@@ -579,7 +602,9 @@ class RpcServer:
         codec = Codec(config=self.transport_config, stats=self.stats)
         # the client declares codec support at handshake time; anything
         # it doesn't declare gets legacy single-blob frames forever
-        codec.oob = protocol.PROTO_OOB1 in request.query.get("proto", "").split(",")
+        declared = request.query.get("proto", "").split(",")
+        codec.oob = protocol.PROTO_OOB1 in declared
+        codec.trace = protocol.PROTO_TRACE1 in declared
         self._clients[client_id] = ws
         self._client_users[client_id] = info
         self._client_codecs[client_id] = codec
@@ -588,7 +613,7 @@ class RpcServer:
             "client_id": client_id,
             "workspace": info.workspace,
             "user_id": info.user_id,
-            "protocols": [protocol.PROTO_OOB1],
+            "protocols": [protocol.PROTO_OOB1, protocol.PROTO_TRACE1],
         }
         if codec.oob and self._shm_store is not None:
             # same-host probe: the client must read this nonce OUT OF
@@ -771,10 +796,17 @@ class RpcServer:
                 logger=self.logger,
             )
         elif t == protocol.RESULT:
+            if msg.get("spans"):
+                # spans a provider recorded while serving a sampled
+                # call — absorbed here so the control-plane process
+                # can hand back one cross-process tree via get_traces
+                tracing.absorb_spans(msg["spans"])
             fut = self._pending.get(msg.get("call_id", ""))
             if fut and not fut.done():
                 fut.set_result(msg.get("result"))
         elif t == protocol.ERROR:
+            if msg.get("spans"):
+                tracing.absorb_spans(msg["spans"])
             fut = self._pending.get(msg.get("call_id", ""))
             if fut and not fut.done():
                 err = msg.get("error")
@@ -789,6 +821,15 @@ class RpcServer:
         info: TokenInfo,
         msg: dict,
     ) -> None:
+        # a sampled caller's trace context wraps the whole dispatch:
+        # spans recorded here (and piggybacked by a downstream
+        # provider) ship back to the caller on the response frame
+        ctx = token = None
+        if codec is not None and codec.trace and isinstance(
+            msg.get("trace"), dict
+        ):
+            ctx = tracing.TraceContext.from_wire(msg["trace"])
+            token = tracing.activate(ctx)
         try:
             result = await self.call_service_method(
                 msg["service_id"],
@@ -797,18 +838,25 @@ class RpcServer:
                 msg.get("kwargs", {}),
                 caller=info,
             )
-            await self._send(
+            response = {
+                "t": protocol.RESULT,
+                "call_id": msg.get("call_id"),
+                "result": result,
+            }
+            if ctx is not None and ctx.collector:
+                response["spans"] = ctx.collector
+            await self._send(ws, codec, response)
+        except Exception as e:
+            await self._send_error(
                 ws,
                 codec,
-                {
-                    "t": protocol.RESULT,
-                    "call_id": msg.get("call_id"),
-                    "result": result,
-                },
+                msg.get("call_id"),
+                e,
+                spans=ctx.collector if ctx is not None else None,
             )
-        except Exception as e:
-            await self._send_error(ws, codec, msg.get("call_id"), e)
         finally:
+            if token is not None:
+                tracing.deactivate(token)
             if codec is not None:
                 # call args decoded from shm refs are dead once the
                 # handler returns — release their pins promptly
@@ -820,7 +868,9 @@ class RpcServer:
         codec: Optional[Codec],
         call_id: Optional[str],
         error: Exception,
+        spans: Optional[list] = None,
     ) -> None:
-        await self._send(
-            ws, codec, {"t": protocol.ERROR, "call_id": call_id, "error": error}
-        )
+        msg = {"t": protocol.ERROR, "call_id": call_id, "error": error}
+        if spans:
+            msg["spans"] = spans
+        await self._send(ws, codec, msg)

@@ -37,6 +37,7 @@ from typing import Any, Optional
 import msgpack
 
 from bioengine_tpu.rpc import protocol
+from bioengine_tpu.utils import metrics
 
 
 def _env_mb(name: str, default_mb: float) -> int:
@@ -84,7 +85,7 @@ class TransportConfig:
         )
 
 
-@dataclass
+@dataclass(eq=False)  # identity semantics — instances live in a WeakSet
 class RpcStats:
     """Data-plane counters for one server or one client connection.
 
@@ -113,6 +114,12 @@ class RpcStats:
     shm_fallbacks: int = 0       # store absent/full -> wire frame
     legacy_msgs_out: int = 0     # peers without oob1
 
+    def __post_init__(self) -> None:
+        # every live stats object feeds the process-wide metrics plane
+        # at scrape time (utils/metrics.py collector) — describe() and
+        # GET /metrics read the SAME counters, no double bookkeeping
+        _STATS_INSTANCES.add(self)
+
     def as_dict(self) -> dict:
         with self.lock:
             d = dict(self.__dict__)
@@ -124,6 +131,47 @@ class RpcStats:
             round(d["shm_puts"] / shm_total, 4) if shm_total else None
         )
         return d
+
+
+_RPC_METRIC_FIELDS = (
+    "bytes_out", "bytes_in", "msgs_out", "msgs_in", "frames_out",
+    "frames_in", "chunked_msgs_out", "chunked_msgs_in", "encode_seconds",
+    "decode_seconds", "shm_puts", "shm_put_bytes", "shm_gets",
+    "shm_get_bytes", "shm_fallbacks", "legacy_msgs_out",
+)
+
+
+def _collect_rpc_stats(instances: list) -> list:
+    """Fold every live RpcStats (server + each client connection in
+    this process) into process totals. Per-connection breakdowns stay
+    on describe(); the metrics plane wants the aggregate an autoscaler
+    or dashboard keys on."""
+    totals = dict.fromkeys(_RPC_METRIC_FIELDS, 0.0)
+    for st in instances:
+        with st.lock:
+            for f in _RPC_METRIC_FIELDS:
+                totals[f] += getattr(st, f)
+    samples = [
+        metrics.Sample(
+            f"rpc_{name}",
+            round(value, 4),
+            kind="counter",
+            help=f"RPC transport {name.replace('_', ' ')} (process total)",
+        )
+        for name, value in totals.items()
+    ]
+    samples.append(
+        metrics.Sample(
+            "rpc_stats_instances",
+            len(instances),
+            kind="gauge",
+            help="live RpcStats objects (server + client connections)",
+        )
+    )
+    return samples
+
+
+_STATS_INSTANCES = metrics.InstanceSet("rpc_transport", _collect_rpc_stats)
 
 
 def chunk_frames(frame, frame_limit: int) -> list:
@@ -342,6 +390,7 @@ class Codec:
         self.config = config or TransportConfig.from_env()
         self.stats = stats or RpcStats()
         self.oob = False                 # peer speaks PROTO_OOB1
+        self.trace = False               # peer speaks PROTO_TRACE1
         self.shm_store = None            # negotiated same-host store
         self._tracker: Optional[ShmPinTracker] = None
         self._assembler = FrameAssembler(

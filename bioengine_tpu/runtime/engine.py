@@ -63,6 +63,7 @@ from bioengine_tpu.runtime.program_cache import (
     CompiledProgramCache,
     default_program_cache,
 )
+from bioengine_tpu.utils import tracing
 
 
 def resolve_devices(
@@ -406,7 +407,35 @@ class InferenceEngine:
         linear blend stitching (the reference's blockwise path, ref
         apps/model-runner/runtime_deployment.py:277-280) through the
         overlapped pipeline; ``pipeline_depth=0`` falls back to the
-        serial path."""
+        serial path.
+
+        Under a sampled request trace the whole prediction records an
+        ``engine.predict`` span whose attrs carry the PipelineStats
+        per-stage delta (h2d put / dispatch / compute / readback /
+        stitch seconds) — the device-side half of the request's latency
+        breakdown. Unsampled requests skip all of it."""
+        ctx = tracing.current_trace()
+        if ctx is None or not ctx.sampled:
+            return self._predict_impl(images)
+        before = self.pipeline_stats.as_dict()
+        with tracing.span(
+            "engine.predict",
+            model=self.model_id,
+            batch=int(np.asarray(images).shape[0]),
+            mesh=self._mesh_key,
+        ) as record:
+            out = self._predict_impl(images)
+            after = self.pipeline_stats.as_dict()
+            record["attrs"]["stage_seconds"] = {
+                k.removesuffix("_seconds"): round(after[k] - before[k], 6)
+                for k in (
+                    "cut_seconds", "put_seconds", "dispatch_seconds",
+                    "compute_seconds", "readback_seconds", "stitch_seconds",
+                )
+            }
+        return out
+
+    def _predict_impl(self, images: np.ndarray) -> np.ndarray:
         images = self._validate(images)
         specs = self._axis_specs(images.ndim)
         if self._needs_tiling(images, specs):
@@ -440,7 +469,11 @@ class InferenceEngine:
         while the pipeline's own staging/stitch threads overlap it)."""
         import asyncio
 
-        return await asyncio.wrap_future(self.submit(self.predict, images))
+        # contextvars don't cross into the dispatch thread on their
+        # own — carry() re-activates a sampled trace there (and is the
+        # identity function when unsampled)
+        fn = tracing.carry(tracing.current_trace(), self.predict)
+        return await asyncio.wrap_future(self.submit(fn, images))
 
     def _predict_direct(self, x: np.ndarray, specs: list["_AxisSpec"]) -> np.ndarray:
         """Bucket every spatial axis, pad into a reusable staging

@@ -37,6 +37,37 @@ from typing import Any, Callable, Iterable, Optional
 
 import numpy as np
 
+from bioengine_tpu.utils import metrics
+
+
+def _collect_pipelines(instances: list) -> list:
+    """Fold every live PipelineStats into process totals for the
+    metrics plane — the same objects Replica.describe reads per
+    replica, summed to the device-busy/overlap signal a scheduler
+    wants per worker."""
+    fields = (
+        "runs", "chunks", "items", "cut_seconds", "put_seconds",
+        "dispatch_seconds", "compute_seconds", "readback_seconds",
+        "stitch_seconds", "wall_seconds",
+    )
+    totals = dict.fromkeys(fields, 0.0)
+    for st in instances:
+        with st._lock:
+            for f in fields:
+                totals[f] += getattr(st, f)
+    return [
+        metrics.Sample(
+            f"pipeline_{name}",
+            round(value, 4),
+            kind="counter",
+            help=f"overlapped-pipeline cumulative {name.replace('_', ' ')}",
+        )
+        for name, value in totals.items()
+    ]
+
+
+_PIPELINE_STATS = metrics.InstanceSet("pipeline_stats", _collect_pipelines)
+
 
 class PipelineStats:
     """Cumulative per-stage accounting for one engine's pipeline.
@@ -68,6 +99,7 @@ class PipelineStats:
         self.max_in_flight = 0
         for name in self._FIELDS:
             setattr(self, name, 0)
+        _PIPELINE_STATS.add(self)
 
     def add(self, **deltas: float) -> None:
         with self._lock:

@@ -22,6 +22,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Hashable, Optional
 
+from bioengine_tpu.utils import metrics, tracing
 from bioengine_tpu.utils.tasks import spawn_supervised
 
 
@@ -30,6 +31,66 @@ class _PendingRequest:
     payload: Any
     future: asyncio.Future
     enqueued_at: float = field(default_factory=time.monotonic)
+    # sampled-trace identity captured at submit: queue-wait is only
+    # measurable at flush time, so the span is recorded retroactively
+    # against the submitter's trace (None when unsampled — free).
+    # parent_span is the submitter's enclosing span (replica.execute)
+    # — the flush task's contextvars can't provide it
+    trace_ctx: Any = None
+    parent_span: Optional[str] = None
+
+
+def _collect_batchers(instances: list) -> list:
+    """Fold live ContinuousBatcher stats into process metrics: request
+    and batch counters plus queue-wait quantiles. The stats dict stays
+    the one bookkeeper; this is a scrape-time reader."""
+    requests = batches = batched = 0
+    waits: list[float] = []
+    for b in instances:
+        requests += b._stats["requests"]
+        batches += b._stats["batches"]
+        batched += b._stats["batched_requests"]
+        waits.extend(b._wait_samples)
+    out = [
+        metrics.Sample(
+            "batcher_requests_total", requests, kind="counter",
+            help="requests submitted to continuous batchers",
+        ),
+        metrics.Sample(
+            "batcher_batches_total", batches, kind="counter",
+            help="batch flushes executed",
+        ),
+        metrics.Sample(
+            "batcher_batched_requests_total", batched, kind="counter",
+            help="requests served through a batched flush",
+        ),
+    ]
+    if waits:
+        waits.sort()
+        out.append(
+            metrics.Sample(
+                "batcher_queue_wait_ms",
+                round(1000 * waits[len(waits) // 2], 3),
+                {"quantile": "p50"},
+                help="recent queue wait before flush",
+            )
+        )
+        out.append(
+            metrics.Sample(
+                "batcher_queue_wait_ms",
+                round(
+                    1000
+                    * waits[min(int(len(waits) * 0.95), len(waits) - 1)],
+                    3,
+                ),
+                {"quantile": "p95"},
+                help="recent queue wait before flush",
+            )
+        )
+    return out
+
+
+_BATCHERS = metrics.InstanceSet("continuous_batcher", _collect_batchers)
 
 
 BatchFn = Callable[[Hashable, list[Any]], Awaitable[list[Any]]]
@@ -59,13 +120,23 @@ class ContinuousBatcher:
         # flush; bounded so stats cost stays flat under load
         self._wait_samples: deque[float] = deque(maxlen=1024)
         self._closed = False
+        _BATCHERS.add(self)
 
     async def submit(self, signature: Hashable, payload: Any) -> Any:
         if self._closed:
             raise RuntimeError("batcher is closed")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         group = self._groups.setdefault(signature, [])
-        group.append(_PendingRequest(payload, fut))
+        ctx = tracing.current_trace()
+        sampled = ctx is not None and ctx.sampled
+        group.append(
+            _PendingRequest(
+                payload,
+                fut,
+                trace_ctx=ctx if sampled else None,
+                parent_span=tracing.current_span_id() if sampled else None,
+            )
+        )
         self._stats["requests"] += 1
         if len(group) >= self.max_batch:
             self._cancel_timer(signature)
@@ -128,7 +199,22 @@ class ContinuousBatcher:
         self._stats["batches"] += 1
         self._stats["batched_requests"] += len(group)
         now = time.monotonic()
+        now_wall = time.time()
         self._wait_samples.extend(now - r.enqueued_at for r in group)
+        for r in group:
+            if r.trace_ctx is not None:
+                wait = now - r.enqueued_at
+                # parent = the submitter's enclosing span, started_at
+                # back-dated to the enqueue — the span sorts where the
+                # wait actually happened in the tree
+                tracing.record_span(
+                    "batch.queue",
+                    wait,
+                    started_at=now_wall - wait,
+                    parent_id=r.parent_span,
+                    ctx=r.trace_ctx,
+                    batch_size=len(group),
+                )
         try:
             results = await self.batch_fn(
                 signature, [r.payload for r in group]

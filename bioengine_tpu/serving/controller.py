@@ -41,8 +41,101 @@ from bioengine_tpu.serving.replica import (
     Replica,
     ReplicaState,
 )
+from bioengine_tpu.utils import metrics, tracing
 from bioengine_tpu.utils.backoff import full_jitter_delay
 from bioengine_tpu.utils.logger import create_logger
+
+# ---- request-path metrics (process-wide, utils/metrics.py) ---------------
+# e2e latency is what the SLO dashboard reads; outcome/failover counters
+# are what the future global scheduler keys on (ROADMAP item 1)
+REQUEST_E2E = metrics.histogram(
+    "request_e2e_seconds",
+    "end-to-end DeploymentHandle.call latency (route + retries + execute)",
+    ("app", "deployment", "method"),
+)
+REQUEST_OUTCOMES = metrics.counter(
+    "requests_total",
+    "completed DeploymentHandle.call requests by outcome",
+    ("app", "deployment", "outcome"),
+)
+REQUEST_FAILOVERS = metrics.counter(
+    "request_failovers_total",
+    "attempts retried on another replica after a transport failure",
+    ("app", "deployment"),
+)
+ROUTE_WAIT = metrics.histogram(
+    "route_wait_seconds",
+    "time spent picking (or waiting for) a routable replica",
+    ("app", "deployment"),
+)
+BREAKER_TRIPS = metrics.counter(
+    "breaker_trips_total",
+    "circuit-breaker ejections (replica marked UNHEALTHY)",
+    ("app", "deployment"),
+)
+
+
+def _collect_controllers(instances: list) -> list:
+    """Scrape-time gauges from live controllers: router queue depth,
+    replica states, and chip-lease occupancy — the load features the
+    autoscaler/scheduler consumes, now exported instead of thrown
+    away after each health tick. Values aggregate across controllers
+    (tests build several per process; one Prometheus series per label
+    set must stay unique)."""
+    depth_by_key: dict[tuple, int] = {}
+    replicas_by_key: dict[tuple, int] = {}
+    breaker_open = 0
+    chips_total = 0
+    chips_free = 0
+    for c in instances:
+        for (app_id, dep), depth in list(c._queue_depth.items()):
+            key = (app_id, dep)
+            depth_by_key[key] = depth_by_key.get(key, 0) + depth
+        for app in list(c.apps.values()):
+            for dep_name, replicas in list(app.replicas.items()):
+                for r in list(replicas):
+                    key = (app.app_id, dep_name, r.state.value)
+                    replicas_by_key[key] = replicas_by_key.get(key, 0) + 1
+        breaker_open += len(c._breaker_counts)
+        chips_total += c.cluster_state.topology.n_chips
+        chips_free += c.cluster_state.free_chips()
+    out = [
+        metrics.Sample(
+            "serve_queue_depth",
+            depth,
+            {"app": app_id, "deployment": dep},
+            help="requests currently inside DeploymentHandle.call",
+        )
+        for (app_id, dep), depth in depth_by_key.items()
+    ]
+    out.extend(
+        metrics.Sample(
+            "serve_replicas",
+            n,
+            {"app": app_id, "deployment": dep, "state": state},
+            help="replicas by lifecycle state",
+        )
+        for (app_id, dep, state), n in replicas_by_key.items()
+    )
+    out.append(
+        metrics.Sample(
+            "breaker_open_replicas",
+            breaker_open,
+            help="replicas with a non-zero consecutive transport-failure count",
+        )
+    )
+    out.append(
+        metrics.Sample("chips_total", chips_total, help="chips on local hosts")
+    )
+    out.append(
+        metrics.Sample(
+            "chips_free", chips_free, help="unleased chips on local hosts"
+        )
+    )
+    return out
+
+
+_CONTROLLERS = metrics.InstanceSet("serve_controller", _collect_controllers)
 
 
 @dataclass(frozen=True)
@@ -141,6 +234,11 @@ class DeploymentHandle:
         self.deployment = deployment
         self._options = options
         self._rr = itertools.count()
+        # labeled children resolved once — labels() costs a few us of
+        # str()/tuple/lock per lookup, paid per request otherwise
+        self._m_route_wait = ROUTE_WAIT.labels(app_id, deployment)
+        self._m_e2e: dict[str, Any] = {}       # method -> histogram child
+        self._m_outcomes: dict[str, Any] = {}  # outcome -> counter child
 
     def with_options(self, options: RequestOptions) -> "DeploymentHandle":
         """A sibling handle whose calls default to ``options``."""
@@ -158,6 +256,82 @@ class DeploymentHandle:
             options = None
         options = options or self._options or RequestOptions.defaults()
 
+        # Observability wrapper. A trace context is minted here (the
+        # client edge of the serve path) and rides the contextvar
+        # through routing, the RPC envelope (capability-negotiated),
+        # the host's replica, batcher, and engine — get_traces
+        # reassembles one cross-process tree per trace_id. Head
+        # sampling (BIOENGINE_TRACE_SAMPLE) keeps the unsampled path
+        # at one id mint + a few counter bumps; BIOENGINE_TRACING=0
+        # removes even that (the bench's baseline leg) — but metrics
+        # and slow-request logging have their OWN knobs and keep
+        # working with tracing off. If a sampled trace is ALREADY
+        # active (a composition call routed back through serve-router),
+        # nest under it instead of minting.
+        parent = tracing.current_trace()
+        ctx = parent if parent is not None else tracing.maybe_start_trace()
+        token = (
+            tracing.activate(ctx)
+            if ctx is not None and parent is None
+            else None
+        )
+        t0 = time.monotonic()
+        outcome = "ok"
+        try:
+            if ctx is not None and ctx.sampled:
+                with tracing.span(
+                    "request",
+                    app=self.app_id,
+                    deployment=self.deployment,
+                    method=method,
+                    trace_root=parent is None,
+                ):
+                    return await self._call_attempts(
+                        method, args, kwargs, options
+                    )
+            return await self._call_attempts(method, args, kwargs, options)
+        except Exception as e:
+            kind = classify_exception(e)
+            outcome = {
+                FailureKind.APPLICATION: "app_error",
+                FailureKind.DEADLINE: "deadline",
+            }.get(kind, "transport_error")
+            raise
+        finally:
+            duration = time.monotonic() - t0
+            if token is not None:
+                tracing.deactivate(token)
+            if metrics.metrics_enabled():
+                e2e = self._m_e2e.get(method)
+                if e2e is None:
+                    e2e = self._m_e2e[method] = REQUEST_E2E.labels(
+                        self.app_id, self.deployment, method
+                    )
+                e2e.observe(duration)
+                out_c = self._m_outcomes.get(outcome)
+                if out_c is None:
+                    out_c = self._m_outcomes[outcome] = REQUEST_OUTCOMES.labels(
+                        self.app_id, self.deployment, outcome
+                    )
+                out_c.inc()
+            slow_ms = tracing.slow_request_threshold_ms()
+            if slow_ms > 0 and duration * 1000.0 >= slow_ms:
+                # structured + trace_id-stamped: grep the log line,
+                # then get_traces(trace_id=...) for the breakdown
+                # (trace_id=- when tracing is globally disabled)
+                self._controller.logger.warning(
+                    "slow_request "
+                    f"trace_id={ctx.trace_id if ctx else '-'} "
+                    f"app={self.app_id} "
+                    f"deployment={self.deployment} method={method} "
+                    f"duration_ms={duration * 1000.0:.1f} "
+                    f"outcome={outcome} "
+                    f"sampled={ctx.sampled if ctx else False}"
+                )
+
+    async def _call_attempts(
+        self, method: str, args: tuple, kwargs: dict, options: RequestOptions
+    ) -> Any:
         deadline = (
             time.monotonic() + options.deadline_s
             if options.deadline_s is not None
@@ -174,9 +348,15 @@ class DeploymentHandle:
                     f"deadline exhausted after {attempt - 1} attempt(s) "
                     f"for {self.app_id}/{self.deployment}.{method}"
                 )
-            replica = await self._controller._pick_replica_wait(
-                self.app_id, self.deployment, avoid=tried, deadline=deadline
-            )
+            t_route = time.monotonic()
+            with tracing.trace_span(
+                "route", app=self.app_id, deployment=self.deployment
+            ):
+                replica = await self._controller._pick_replica_wait(
+                    self.app_id, self.deployment, avoid=tried, deadline=deadline
+                )
+            if metrics.metrics_enabled():
+                self._m_route_wait.observe(time.monotonic() - t_route)
             # the wait above may have parked through most of the budget
             # — recompute so the attempt (and the host-side timeout it
             # propagates) cannot overrun the overall deadline
@@ -190,9 +370,14 @@ class DeploymentHandle:
             budget = _min_defined(options.timeout_s, remaining)
             self._controller._queue_depth[key] += 1
             try:
-                result = await replica.call_bounded(
-                    method, args, kwargs, timeout_s=budget
-                )
+                with tracing.trace_span(
+                    "attempt",
+                    replica=replica.replica_id,
+                    attempt=attempt,
+                ):
+                    result = await replica.call_bounded(
+                        method, args, kwargs, timeout_s=budget
+                    )
                 self._controller._breaker_success(replica)
                 return result
             except Exception as e:
@@ -238,6 +423,8 @@ class DeploymentHandle:
                         f"{self.app_id}/{self.deployment}.{method} failed "
                         f"after {attempt} attempts: {e}"
                     ) from e
+                if metrics.metrics_enabled():
+                    REQUEST_FAILOVERS.labels(self.app_id, self.deployment).inc()
                 # exponential backoff with FULL jitter, clamped to the
                 # remaining deadline budget
                 delay = full_jitter_delay(
@@ -299,6 +486,7 @@ class ServeController:
         self._replicas_changed = asyncio.Event()
         self._rpc_server = None            # set by attach_rpc (multi-host)
         self._router_admins: list[str] = []
+        _CONTROLLERS.add(self)             # scrape-time serving gauges
 
     # ---- multi-host control plane -------------------------------------------
 
@@ -712,6 +900,10 @@ class ServeController:
             self.logger.warning(
                 f"breaker ejected replica {rid} after {n} transport failures"
             )
+            if metrics.metrics_enabled():
+                BREAKER_TRIPS.labels(
+                    replica.app_id, replica.deployment_name
+                ).inc()
             self._wake_health.set()
 
     def _breaker_success(self, replica) -> None:

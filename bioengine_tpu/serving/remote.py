@@ -32,6 +32,7 @@ from bioengine_tpu.serving.replica import (
     ROUTABLE_STATES,
     ReplicaState,
 )
+from bioengine_tpu.utils import tracing
 
 
 class RemoteReplica:
@@ -60,6 +61,7 @@ class RemoteReplica:
         self.drain_timeout_s = drain_timeout_s
         self.state = ReplicaState.STARTING
         self.started_at = time.time()
+        self._started_mono = time.monotonic()
         self.last_error: Optional[str] = None
         self._payload = payload
         self._call_host = call_host
@@ -201,15 +203,25 @@ class RemoteReplica:
                 # transport timeout gets slack so the host's (typed)
                 # TimeoutError wins the race over a bare client timeout
                 extra = {"timeout_s": timeout_s, "rpc_timeout": timeout_s + 5.0}
-            return await self._call_host(
-                self.host_service_id,
-                "replica_call",
-                self.replica_id,
-                method,
-                list(args),
-                kwargs or {},
-                **extra,
-            )
+            # the sampled trace context crosses to the host inside the
+            # RPC envelope (server.call_service_method reads the
+            # contextvar); this span is the controller-side view of the
+            # whole remote hop (encode + wire + host-side work)
+            with tracing.trace_span(
+                "remote.call",
+                replica=self.replica_id,
+                host=self.host_id,
+                method=method,
+            ):
+                return await self._call_host(
+                    self.host_service_id,
+                    "replica_call",
+                    self.replica_id,
+                    method,
+                    list(args),
+                    kwargs or {},
+                    **extra,
+                )
         except KeyError as e:
             # a raw KeyError here is the ROUTER's (host service gone
             # from the registry, i.e. the websocket dropped) — app
@@ -240,6 +252,6 @@ class RemoteReplica:
             # the controller rollup treats a missing key as unknown
             "total_requests": self._total_requests,
             "load": self.load,
-            "uptime_seconds": time.time() - self.started_at,
+            "uptime_seconds": time.monotonic() - self._started_mono,
             "last_error": self.last_error,
         }

@@ -27,10 +27,30 @@ import uuid
 from typing import Any, Callable, Optional
 
 from bioengine_tpu.serving.errors import ReplicaUnavailableError
+from bioengine_tpu.utils import metrics, tracing
 from bioengine_tpu.utils.logger import create_logger
 
 DEFAULT_DRAIN_TIMEOUT_S = float(
     os.environ.get("BIOENGINE_DRAIN_TIMEOUT_S", "30")
+)
+
+# per-replica request telemetry: the counter REPLACES the old private
+# _total_requests int (describe() reads it back — one bookkeeper), the
+# histograms are what GET /metrics serves labeled by deployment+replica
+REPLICA_REQUESTS = metrics.counter(
+    "replica_requests_total",
+    "requests executed by a replica instance",
+    ("app", "deployment", "replica"),
+)
+REPLICA_LATENCY = metrics.histogram(
+    "replica_request_seconds",
+    "instance method execution time on the replica (post-semaphore)",
+    ("app", "deployment", "replica"),
+)
+REPLICA_PARK = metrics.histogram(
+    "replica_park_seconds",
+    "time a call waited on the replica's request semaphore",
+    ("app", "deployment", "replica"),
 )
 
 
@@ -72,11 +92,17 @@ class Replica:
         self._queued = 0          # callers parked on the semaphore
         self._idle_event = asyncio.Event()
         self._idle_event.set()
-        self._total_requests = 0
+        # label children bind in start(): worker_host reassigns
+        # replica_id between construction and start, and the metric
+        # identity must match the controller's
+        self._requests_total: Optional[metrics.CounterChild] = None
+        self._m_latency: Optional[metrics.HistogramChild] = None
+        self._m_park: Optional[metrics.HistogramChild] = None
         self._test_task: Optional[asyncio.Task] = None
         self._test_error: Optional[str] = None
         self._init_done = False
         self.started_at = time.time()
+        self._started_mono = time.monotonic()
         self.last_error: Optional[str] = None
         self._log_sink = log_sink
         self.logger = create_logger(f"replica.{self.replica_id}", log_file="off")
@@ -95,6 +121,10 @@ class Replica:
         ref builder.py:739-890)."""
         try:
             self.state = ReplicaState.INITIALIZING
+            labels = (self.app_id, self.deployment_name, self.replica_id)
+            self._requests_total = REPLICA_REQUESTS.labels(*labels)
+            self._m_latency = REPLICA_LATENCY.labels(*labels)
+            self._m_park = REPLICA_PARK.labels(*labels)
             self._log("constructing deployment instance")
             self.instance = self._instance_factory()
             if self.device_ids:
@@ -228,11 +258,16 @@ class Replica:
             raise AttributeError(
                 f"{self.deployment_name} has no method '{method}'"
             )
+        m_on = metrics.metrics_enabled()
         self._queued += 1
+        t_park = time.monotonic()
         try:
-            await self._semaphore.acquire()
+            with tracing.trace_span("replica.park", replica=self.replica_id):
+                await self._semaphore.acquire()
         finally:
             self._queued -= 1
+        if m_on and self._m_park is not None:
+            self._m_park.observe(time.monotonic() - t_park)
         try:
             # re-check after the (possibly long) semaphore wait: a drain
             # or stop that happened while this call was parked must not
@@ -244,10 +279,19 @@ class Replica:
                 )
             self._ongoing += 1
             self._idle_event.clear()
-            self._total_requests += 1
+            if self._requests_total is not None:
+                self._requests_total.inc()
+            t_exec = time.monotonic()
             try:
-                return await _maybe_await(fn(*args, **kwargs))
+                with tracing.trace_span(
+                    "replica.execute",
+                    replica=self.replica_id,
+                    method=method,
+                ):
+                    return await _maybe_await(fn(*args, **kwargs))
             finally:
+                if m_on and self._m_latency is not None:
+                    self._m_latency.observe(time.monotonic() - t_exec)
                 self._ongoing -= 1
                 if self._ongoing == 0:
                     self._idle_event.set()
@@ -281,9 +325,17 @@ class Replica:
             "device_ids": self.device_ids,
             "ongoing_requests": self._ongoing,
             "queued_requests": self._queued,
-            "total_requests": self._total_requests,
+            # backed by the process-wide metrics registry (same counter
+            # GET /metrics serves) — describe() is a reader, not a
+            # second bookkeeper
+            "total_requests": (
+                int(self._requests_total.value)
+                if self._requests_total is not None
+                else 0
+            ),
             "load": self.load,
-            "uptime_seconds": time.time() - self.started_at,
+            # monotonic, not wall — an NTP step must not age a replica
+            "uptime_seconds": time.monotonic() - self._started_mono,
             "last_error": self.last_error,
         }
         # deployments that run the overlapped inference pipeline expose

@@ -1,0 +1,467 @@
+"""Process-wide metrics registry — counters, gauges, histograms.
+
+The serving stack grew one private stats island per subsystem
+(``RpcStats``, ``PipelineStats``, batcher ``stats``, breaker counts,
+chip leases) with no shared identity: answering "what is this worker
+doing" meant reading four ``describe()`` dicts that never line up.
+This module is the one place request-path telemetry accumulates:
+
+- **First-class metrics** — ``counter`` / ``gauge`` / ``histogram``
+  return process-wide metric families; ``.labels(...)`` hands back a
+  child whose hot path is one dict lookup + one small lock (children
+  are cached, label tuples interned by the dict itself). Histograms
+  use explicit buckets (Prometheus convention: cumulative ``le``).
+- **Collectors** — existing stats objects stay the single source of
+  truth for their ``describe()`` schemas; they register a zero-cost
+  callback that converts their counters into samples at *scrape* time.
+  No double bookkeeping: the request path mutates one object, and
+  ``describe()`` and ``/metrics`` both read it.
+
+Rendered two ways: :func:`collect` (a JSON-able snapshot for the
+``get_metrics`` worker verb) and :func:`render_prometheus` (text
+exposition format v0.0.4 for ``GET /metrics``).
+
+Label discipline: keep cardinality bounded by things an operator can
+enumerate — app, deployment, replica, method family — never user ids
+or request ids (those belong on traces, utils/tracing.py).
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+import weakref
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+# Prometheus-convention latency buckets (seconds). Explicit, not
+# exponential-by-config: the serve path spans ~1 ms (cache-hit CPU
+# calls) to minutes (cold compiles), and fixed edges keep dashboards
+# comparable across workers.
+LATENCY_BUCKETS_S = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+
+class _Child:
+    """One labeled series. Base for Counter/Gauge children."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class CounterChild(_Child):
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += amount
+
+
+class GaugeChild(_Child):
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+
+class HistogramChild:
+    __slots__ = ("_lock", "_edges", "_counts", "_sum", "_count")
+
+    def __init__(self, edges: Sequence[float]):
+        self._lock = threading.Lock()
+        self._edges = list(edges)
+        self._counts = [0] * (len(self._edges) + 1)  # + overflow
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        idx = bisect.bisect_left(self._edges, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+
+    def snapshot(self) -> dict:
+        """Cumulative bucket counts keyed by upper edge (rendered as
+        strings — the snapshot crosses the RPC plane, and msgpack's
+        strict_map_key rejects float keys), plus sum/count and the
+        quantile estimates operators actually read."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+            s = self._sum
+        cum = 0
+        buckets = {}
+        for edge, n in zip(self._edges, counts):
+            cum += n
+            buckets[_fmt(edge)] = cum
+        return {
+            "buckets": buckets,
+            "count": total,
+            "sum": round(s, 6),
+            "p50": self._quantile(counts, total, 0.50),
+            "p95": self._quantile(counts, total, 0.95),
+            "p99": self._quantile(counts, total, 0.99),
+        }
+
+    def _quantile(self, counts: list, total: int, q: float) -> Optional[float]:
+        """Upper-edge estimate of quantile ``q`` (None when empty,
+        inf when it lands in the overflow bucket)."""
+        if total == 0:
+            return None
+        target = math.ceil(q * total)
+        cum = 0
+        for edge, n in zip(self._edges, counts):
+            cum += n
+            if cum >= target:
+                return edge
+        return math.inf
+
+
+class _Family:
+    """A named metric family with a fixed label schema."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str]):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._children: dict[tuple, Any] = {}
+        self._lock = threading.Lock()
+
+    def _make_child(self):
+        raise NotImplementedError
+
+    def labels(self, *values: Any) -> Any:
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name} takes labels {self.labelnames}, got {values}"
+            )
+        key = tuple(str(v) for v in values)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(key, self._make_child())
+        return child
+
+    def items(self) -> list[tuple[tuple, Any]]:
+        with self._lock:
+            return list(self._children.items())
+
+
+class Counter(_Family):
+    kind = "counter"
+
+    def _make_child(self):
+        return CounterChild()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.labels().inc(amount)  # unlabeled convenience
+
+
+class Gauge(_Family):
+    kind = "gauge"
+
+    def _make_child(self):
+        return GaugeChild()
+
+    def set(self, value: float) -> None:
+        self.labels().set(value)
+
+
+class Histogram(_Family):
+    kind = "histogram"
+
+    def __init__(self, name, help, labelnames, buckets=LATENCY_BUCKETS_S):
+        super().__init__(name, help, labelnames)
+        self.buckets = tuple(sorted(buckets))
+
+    def _make_child(self):
+        return HistogramChild(self.buckets)
+
+    def observe(self, value: float) -> None:
+        self.labels().observe(value)
+
+
+class Sample:
+    """One collector-produced series: collectors turn a live stats
+    object (RpcStats, PipelineStats, batcher stats) into samples at
+    scrape time instead of double-writing on the hot path."""
+
+    __slots__ = ("name", "labels", "value", "kind", "help")
+
+    def __init__(
+        self,
+        name: str,
+        value: float,
+        labels: Optional[dict] = None,
+        kind: str = "gauge",
+        help: str = "",
+    ):
+        self.name = name
+        self.value = value
+        self.labels = labels or {}
+        self.kind = kind
+        self.help = help
+
+
+CollectorFn = Callable[[], Iterable[Sample]]
+
+
+class MetricsRegistry:
+    def __init__(self, namespace: str = "bioengine"):
+        self.namespace = namespace
+        self._metrics: dict[str, _Family] = {}
+        self._collectors: dict[str, CollectorFn] = {}
+        self._lock = threading.Lock()
+
+    # ---- first-class metrics ------------------------------------------------
+
+    def _register(self, metric: _Family) -> _Family:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                if type(existing) is not type(metric) or (
+                    existing.labelnames != metric.labelnames
+                ):
+                    raise ValueError(
+                        f"metric '{metric.name}' re-registered with a "
+                        f"different type or label schema"
+                    )
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(
+        self, name: str, help: str = "", labelnames: Sequence[str] = ()
+    ) -> Counter:
+        return self._register(Counter(name, help, labelnames))  # type: ignore[return-value]
+
+    def gauge(
+        self, name: str, help: str = "", labelnames: Sequence[str] = ()
+    ) -> Gauge:
+        return self._register(Gauge(name, help, labelnames))  # type: ignore[return-value]
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        labelnames: Sequence[str] = (),
+        buckets: Sequence[float] = LATENCY_BUCKETS_S,
+    ) -> Histogram:
+        return self._register(Histogram(name, help, labelnames, buckets))  # type: ignore[return-value]
+
+    # ---- collectors ---------------------------------------------------------
+
+    def register_collector(self, name: str, fn: CollectorFn) -> None:
+        """Scrape-time sample source (idempotent by name — re-import
+        of a module that registers at import time must not stack)."""
+        with self._lock:
+            self._collectors[name] = fn
+
+    def unregister_collector(self, name: str) -> None:
+        with self._lock:
+            self._collectors.pop(name, None)
+
+    def _collector_samples(self) -> list[Sample]:
+        with self._lock:
+            collectors = list(self._collectors.items())
+        out: list[Sample] = []
+        for cname, fn in collectors:
+            try:
+                out.extend(fn())
+            except Exception:  # noqa: BLE001 — one bad collector never
+                pass           # breaks the whole scrape
+        return out
+
+    # ---- export -------------------------------------------------------------
+
+    def collect(self) -> dict:
+        """JSON-able snapshot (the ``get_metrics`` verb)."""
+        out: dict[str, Any] = {}
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            series = []
+            for key, child in m.items():
+                labels = dict(zip(m.labelnames, key))
+                if isinstance(child, HistogramChild):
+                    series.append({"labels": labels, **child.snapshot()})
+                else:
+                    series.append({"labels": labels, "value": child.value})
+            out[m.name] = {"type": m.kind, "help": m.help, "series": series}
+        for s in self._collector_samples():
+            entry = out.setdefault(
+                s.name, {"type": s.kind, "help": s.help, "series": []}
+            )
+            entry["series"].append({"labels": s.labels, "value": s.value})
+        return out
+
+    def render_prometheus(self) -> str:
+        """Text exposition format 0.0.4."""
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            full = f"{self.namespace}_{m.name}"
+            if m.help:
+                lines.append(f"# HELP {full} {_escape_help(m.help)}")
+            lines.append(f"# TYPE {full} {m.kind}")
+            for key, child in m.items():
+                labels = dict(zip(m.labelnames, key))
+                if isinstance(child, HistogramChild):
+                    snap = child.snapshot()
+                    for edge, cum in snap["buckets"].items():
+                        lines.append(
+                            _line(
+                                f"{full}_bucket",
+                                {**labels, "le": edge},
+                                cum,
+                            )
+                        )
+                    lines.append(
+                        _line(
+                            f"{full}_bucket",
+                            {**labels, "le": "+Inf"},
+                            snap["count"],
+                        )
+                    )
+                    lines.append(_line(f"{full}_sum", labels, snap["sum"]))
+                    lines.append(_line(f"{full}_count", labels, snap["count"]))
+                else:
+                    lines.append(_line(full, labels, child.value))
+        # collector samples, grouped so TYPE headers appear once
+        grouped: dict[str, list[Sample]] = {}
+        for s in self._collector_samples():
+            grouped.setdefault(s.name, []).append(s)
+        for name, samples in grouped.items():
+            full = f"{self.namespace}_{name}"
+            if samples[0].help:
+                lines.append(f"# HELP {full} {_escape_help(samples[0].help)}")
+            lines.append(f"# TYPE {full} {samples[0].kind}")
+            for s in samples:
+                lines.append(_line(full, s.labels, s.value))
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(v: float) -> str:
+    """Prometheus float formatting: integral values without the dot."""
+    if v == math.inf:
+        return "+Inf"
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
+
+
+def _escape_help(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _line(name: str, labels: dict, value: float) -> str:
+    if labels:
+        inner = ",".join(
+            f'{k}="{_escape_label(str(v))}"' for k, v in sorted(labels.items())
+        )
+        return f"{name}{{{inner}}} {_fmt(float(value))}"
+    return f"{name} {_fmt(float(value))}"
+
+
+# ---------------------------------------------------------------------------
+# The process-wide default registry + module-level conveniences
+# ---------------------------------------------------------------------------
+
+REGISTRY = MetricsRegistry()
+
+_ENABLED: Optional[bool] = None
+
+
+def metrics_enabled() -> bool:
+    """Hot-path kill-switch (``BIOENGINE_METRICS=0``): gates the
+    *optional* request-path observations (latency histograms, park
+    times). Counters that back existing ``describe()`` schemas always
+    run — they replaced the plain ints those schemas already paid for.
+    Read once; tests flip it via :func:`reset_env_cache`."""
+    global _ENABLED
+    if _ENABLED is None:
+        import os
+
+        _ENABLED = os.environ.get("BIOENGINE_METRICS", "1") != "0"
+    return _ENABLED
+
+
+def reset_env_cache() -> None:
+    global _ENABLED
+    _ENABLED = None
+
+
+def counter(name: str, help: str = "", labelnames: Sequence[str] = ()) -> Counter:
+    return REGISTRY.counter(name, help, labelnames)
+
+
+def gauge(name: str, help: str = "", labelnames: Sequence[str] = ()) -> Gauge:
+    return REGISTRY.gauge(name, help, labelnames)
+
+
+def histogram(
+    name: str,
+    help: str = "",
+    labelnames: Sequence[str] = (),
+    buckets: Sequence[float] = LATENCY_BUCKETS_S,
+) -> Histogram:
+    return REGISTRY.histogram(name, help, labelnames, buckets)
+
+
+def register_collector(name: str, fn: CollectorFn) -> None:
+    REGISTRY.register_collector(name, fn)
+
+
+def collect() -> dict:
+    return REGISTRY.collect()
+
+
+def render_prometheus() -> str:
+    return REGISTRY.render_prometheus()
+
+
+# ---------------------------------------------------------------------------
+# Instance-set collectors — the pattern the stats islands plug in with
+# ---------------------------------------------------------------------------
+
+
+class InstanceSet:
+    """Weak set of live stats objects plus a collector that folds them
+    into samples at scrape time. ``RpcStats``/``PipelineStats``/batcher
+    instances register at construction; a dead replica's stats object
+    drops out with the garbage collector, no unregister bookkeeping."""
+
+    def __init__(self, name: str, fold: Callable[[list], Iterable[Sample]]):
+        self._instances: "weakref.WeakSet" = weakref.WeakSet()
+        self._fold = fold
+        register_collector(name, self._collect)
+
+    def add(self, obj: Any) -> None:
+        self._instances.add(obj)
+
+    def _collect(self) -> Iterable[Sample]:
+        return self._fold(list(self._instances))

@@ -1,55 +1,235 @@
-"""Structured spans — lightweight control-plane tracing.
+"""Structured spans + request-scoped distributed tracing.
 
 Complements the jax.profiler surface (worker start/stop_profiling —
-device-side traces) with host-side spans over control-plane
-operations: deploys, replica starts, artifact commits, RPC dispatch.
-SURVEY §5.1's target: the reference has only log lines; spans give
-durations + outcome + nesting without any external collector.
+device-side traces) with host-side spans. Two usage tiers share one
+ring buffer and one ``get_traces`` surface:
 
-A process-wide ring buffer holds the most recent spans; the worker
-exposes them via ``get_traces``. Usage::
+**Control-plane spans** (PR 1 era, unchanged call sites)::
 
     with span("deploy_app", app_id=app_id):
         ...
 
-Nesting is tracked through a contextvar so children record their
-parent span id (async-safe).
+always record — deploys and replica placements are rare and precious.
+
+**Request-scoped traces**: ``DeploymentHandle.call`` mints a
+:class:`TraceContext` (trace_id + head-sampling decision, default
+~1% via ``BIOENGINE_TRACE_SAMPLE``); the context rides a contextvar
+through the routing path, crosses process boundaries in the RPC CALL
+envelope (capability-negotiated ``proto=trace1`` — legacy peers never
+see the fields), and request-path call sites use::
+
+    with trace_span("replica.execute", replica_id=rid):
+        ...
+
+which is a shared no-op object when the request is unsampled — the
+unsampled hot path pays one contextvar read. Spans recorded on a
+remote peer while handling a sampled call are piggybacked onto the
+RPC RESULT frame and absorbed into the caller's buffer, so
+``get_traces(trace_id=...)`` reconstructs ONE cross-process span tree
+with a per-stage latency breakdown.
+
+Timing discipline: durations come from ``time.monotonic()`` (wall
+``time.time()`` deltas jump under NTP slew); ``started_at`` stays wall
+time for display. Spans are appended to the buffer when they OPEN, so
+``get_spans(include_open=True)`` shows in-flight work (a wedged
+request is visible while it hangs, not after).
 """
 
 from __future__ import annotations
 
 import contextvars
-import itertools
+import os
+import random
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager
+from dataclasses import dataclass, field
 from typing import Any, Optional
 
-MAX_SPANS = 2048
+MAX_SPANS = 4096
 
 _spans: deque[dict] = deque(maxlen=MAX_SPANS)
 _lock = threading.Lock()
-_ids = itertools.count(1)
-_current: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
+_current: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
     "bioengine_span", default=None
 )
+_trace: contextvars.ContextVar[Optional["TraceContext"]] = (
+    contextvars.ContextVar("bioengine_trace", default=None)
+)
+
+
+def _new_id() -> str:
+    # random.getrandbits, not uuid4: ids need uniqueness, not crypto
+    # randomness, and uuid4's os.urandom syscall costs ~40 us on
+    # sandboxed kernels — minted per request on the serve hot path
+    return f"{random.getrandbits(64):016x}"
+
+
+def _new_trace_id() -> str:
+    return f"{random.getrandbits(128):032x}"
+
+
+@dataclass
+class TraceContext:
+    """One request's tracing identity.
+
+    ``span_id`` is the parent span on the MINTING side when the context
+    crosses a process boundary; ``collector`` accumulates spans closed
+    under this context so an RPC handler can ship them back on the
+    RESULT frame (None when unsampled — zero collection cost)."""
+
+    trace_id: str
+    span_id: Optional[str] = None
+    sampled: bool = False
+    collector: Optional[list] = None
+
+    def to_wire(self) -> dict:
+        """The trace fields carried on a CALL message (only when the
+        peer negotiated ``trace1`` and the request is sampled)."""
+        return {"tid": self.trace_id, "sid": _current.get() or self.span_id}
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "TraceContext":
+        return cls(
+            trace_id=str(d.get("tid", "")),
+            span_id=d.get("sid"),
+            sampled=True,
+            collector=[],
+        )
+
+
+# ---------------------------------------------------------------------------
+# env knobs (read once — these sit on the request hot path)
+# ---------------------------------------------------------------------------
+
+_ENV_CACHE: dict[str, float] = {}
+
+
+def _cached_env(key: str, default: str) -> float:
+    v = _ENV_CACHE.get(key)
+    if v is None:
+        v = float(os.environ.get(key, default))
+        _ENV_CACHE[key] = v
+    return v
+
+
+def tracing_enabled() -> bool:
+    """Global kill-switch (``BIOENGINE_TRACING=0``) — the bench's
+    baseline leg. Off means no context is minted at all."""
+    return _cached_env("BIOENGINE_TRACING", "1") != 0.0
+
+
+def trace_sample_rate() -> float:
+    """Head-sampling probability, ``BIOENGINE_TRACE_SAMPLE`` (default
+    0.01 — tracing must be affordable at production request rates)."""
+    return _cached_env("BIOENGINE_TRACE_SAMPLE", "0.01")
+
+
+def slow_request_threshold_ms() -> float:
+    """``BIOENGINE_SLOW_REQUEST_MS`` (default 1000); <= 0 disables
+    slow-request logging."""
+    return _cached_env("BIOENGINE_SLOW_REQUEST_MS", "1000")
+
+
+def reset_env_cache() -> None:
+    """Tests flip the env knobs; production reads them once."""
+    _ENV_CACHE.clear()
+
+
+# ---------------------------------------------------------------------------
+# context management
+# ---------------------------------------------------------------------------
+
+
+def maybe_start_trace(sample: Optional[bool] = None) -> Optional[TraceContext]:
+    """Mint a request trace context (head-sampled). Returns None when
+    tracing is globally disabled. The trace_id exists even unsampled so
+    slow-request logs are correlatable; only sampled requests record
+    spans or put fields on the wire."""
+    if not tracing_enabled():
+        return None
+    if sample is None:
+        sample = random.random() < trace_sample_rate()
+    return TraceContext(
+        trace_id=_new_trace_id(),
+        sampled=bool(sample),
+        collector=[] if sample else None,
+    )
+
+
+def activate(ctx: TraceContext):
+    """Install ``ctx`` as the current trace (and its ``span_id`` as the
+    current parent, so local spans chain to the remote caller's span).
+    Returns an opaque token for :func:`deactivate`."""
+    return (_trace.set(ctx), _current.set(ctx.span_id))
+
+
+def deactivate(token) -> None:
+    t_trace, t_span = token
+    _trace.reset(t_trace)
+    _current.reset(t_span)
+
+
+def current_trace() -> Optional[TraceContext]:
+    return _trace.get()
+
+
+def current_span_id() -> Optional[str]:
+    """The enclosing span's id — for call sites that record a span
+    *later* (e.g. the batcher measures queue wait at flush time) and
+    must capture the parent while the request is still in scope."""
+    return _current.get()
+
+
+def carry(ctx: Optional[TraceContext], fn):
+    """Wrap ``fn`` so it runs with ``ctx`` active — the bridge into
+    worker threads (engine dispatch thread, pipeline stages) where
+    asyncio's automatic contextvar propagation does not reach."""
+    if ctx is None or not ctx.sampled:
+        return fn
+
+    parent = _current.get()
+
+    def wrapped(*args, **kwargs):
+        token = _trace.set(ctx)
+        token2 = _current.set(parent)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _current.reset(token2)
+            _trace.reset(token)
+
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# span recording
+# ---------------------------------------------------------------------------
 
 
 @contextmanager
 def span(name: str, **attrs: Any):
-    """Record one span; exceptions mark it failed and re-raise."""
-    span_id = next(_ids)
+    """Record one span; exceptions mark it failed and re-raise.
+    Appended to the buffer at OPEN (visible in-flight), completed in
+    place at close. When a sampled trace context is active the span
+    carries its trace_id and feeds the context's collector."""
+    span_id = _new_id()
     parent = _current.get()
+    ctx = _trace.get()
     token = _current.set(span_id)
-    started = time.time()
     record = {
         "span_id": span_id,
         "parent_id": parent,
         "name": name,
         "attrs": attrs,
-        "started_at": started,
+        "started_at": time.time(),
     }
+    if ctx is not None and ctx.sampled:
+        record["trace_id"] = ctx.trace_id
+    t0 = time.monotonic()
+    with _lock:
+        _spans.append(record)
     try:
         yield record
     except BaseException as e:
@@ -57,20 +237,142 @@ def span(name: str, **attrs: Any):
         raise
     finally:
         _current.reset(token)
-        record["duration_s"] = round(time.time() - started, 6)
-        with _lock:
-            _spans.append(record)
+        record["duration_s"] = round(time.monotonic() - t0, 6)
+        if ctx is not None and ctx.collector is not None:
+            ctx.collector.append(record)
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager — what ``trace_span`` hands
+    the unsampled hot path (no allocation, no lock, no record)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+def trace_span(name: str, **attrs: Any):
+    """``span`` gated on the current request being sampled — the
+    request-path variant. Control-plane call sites keep ``span``."""
+    ctx = _trace.get()
+    if ctx is None or not ctx.sampled:
+        return _NOOP
+    return span(name, **attrs)
+
+
+def record_span(
+    name: str,
+    duration_s: float,
+    started_at: Optional[float] = None,
+    parent_id: Optional[str] = None,
+    ctx: Optional[TraceContext] = None,
+    **attrs: Any,
+) -> Optional[dict]:
+    """After-the-fact span for durations measured elsewhere (e.g. the
+    batcher knows a request's queue wait only at flush time). Recorded
+    only when ``ctx`` (default: current) is sampled."""
+    ctx = ctx if ctx is not None else _trace.get()
+    if ctx is None or not ctx.sampled:
+        return None
+    record = {
+        "span_id": _new_id(),
+        "parent_id": parent_id if parent_id is not None else ctx.span_id,
+        "name": name,
+        "attrs": attrs,
+        "started_at": started_at if started_at is not None else time.time(),
+        "duration_s": round(duration_s, 6),
+        "trace_id": ctx.trace_id,
+    }
+    with _lock:
+        _spans.append(record)
+    if ctx.collector is not None:
+        ctx.collector.append(record)
+    return record
+
+
+def absorb_spans(spans: list) -> int:
+    """Fold spans shipped from a remote peer (RESULT piggyback) into
+    the local buffer so one process can reconstruct the whole tree."""
+    added = 0
+    if not spans:
+        return added
+    with _lock:
+        known = {s["span_id"] for s in _spans if "trace_id" in s}
+        for s in spans:
+            if not isinstance(s, dict) or "span_id" not in s:
+                continue
+            if s["span_id"] in known:
+                continue
+            _spans.append(dict(s))
+            added += 1
+    return added
+
+
+# ---------------------------------------------------------------------------
+# querying
+# ---------------------------------------------------------------------------
 
 
 def get_spans(
-    name: Optional[str] = None, max_spans: int = 200
+    name: Optional[str] = None,
+    max_spans: int = 200,
+    include_open: bool = False,
+    trace_id: Optional[str] = None,
 ) -> list[dict]:
-    """Most recent spans, newest last; optionally filtered by name."""
+    """Most recent spans in OPEN order; filtered by name / trace_id.
+    Open (in-flight) spans are excluded unless ``include_open``."""
     with _lock:
         items = list(_spans)
+    if not include_open:
+        items = [s for s in items if "duration_s" in s]
     if name is not None:
         items = [s for s in items if s["name"] == name]
+    if trace_id is not None:
+        items = [s for s in items if s.get("trace_id") == trace_id]
     return items[-max_spans:]
+
+
+def build_trace_tree(trace_id: str) -> dict:
+    """One request's cross-process span tree: spans nested under their
+    parents, children in start order, plus the stage rollup the SLO
+    dashboards read (name -> summed duration)."""
+    spans = get_spans(
+        trace_id=trace_id, max_spans=MAX_SPANS, include_open=True
+    )
+    by_id: dict[str, dict] = {}
+    for s in spans:
+        node = dict(s)
+        node["children"] = []
+        by_id[s["span_id"]] = node
+    roots = []
+    for node in by_id.values():
+        parent = by_id.get(node.get("parent_id"))
+        if parent is not None:
+            parent["children"].append(node)
+        else:
+            roots.append(node)
+    for node in by_id.values():
+        node["children"].sort(key=lambda n: n.get("started_at", 0.0))
+    roots.sort(key=lambda n: n.get("started_at", 0.0))
+    stages: dict[str, float] = {}
+    for s in spans:
+        if "duration_s" in s:
+            stages[s["name"]] = round(
+                stages.get(s["name"], 0.0) + s["duration_s"], 6
+            )
+    return {
+        "trace_id": trace_id,
+        "spans": len(spans),
+        "stage_seconds": stages,
+        "tree": roots,
+    }
 
 
 def clear_spans() -> int:

@@ -100,7 +100,7 @@ async def run_payload_subprocess(
     Shared by the local executor and the worker-host ``run_code`` verb
     (remote dispatch) so both placements run the identical isolation
     boundary."""
-    started = time.time()
+    started = time.monotonic()
     with tempfile.TemporaryDirectory() as tmp:
         result_path = Path(tmp) / "outcome.pkl"
         proc = await asyncio.create_subprocess_exec(
@@ -155,7 +155,7 @@ async def run_payload_subprocess(
                 "error": f"Execution exceeded {timeout:.0f}s timeout",
                 "stdout": "".join(stdout_chunks),
                 "stderr": "".join(stderr_chunks),
-                "duration_s": time.time() - started,
+                "duration_s": time.monotonic() - started,
             }
         except Exception as e:
             # never leak the child on a pump/drive failure
@@ -167,7 +167,7 @@ async def run_payload_subprocess(
                 "error": f"Execution driver failed: {e}",
                 "stdout": "".join(stdout_chunks),
                 "stderr": "".join(stderr_chunks),
-                "duration_s": time.time() - started,
+                "duration_s": time.monotonic() - started,
             }
 
         outcome: dict[str, Any] = {"result": None, "error": None}
@@ -186,7 +186,7 @@ async def run_payload_subprocess(
         "error": outcome["error"],
         "stdout": "".join(stdout_chunks),
         "stderr": "".join(stderr_chunks),
-        "duration_s": time.time() - started,
+        "duration_s": time.monotonic() - started,
     }
 
 

@@ -91,6 +91,7 @@ class BioEngineWorker:
 
         self.is_ready = False
         self.start_time: Optional[float] = None
+        self._start_mono: Optional[float] = None
         self._monitor_task: Optional[asyncio.Task] = None
         self._monitor_errors = 0
         self._geo_location: Optional[dict] = None
@@ -109,7 +110,8 @@ class BioEngineWorker:
         )
 
         enable_persistent_compilation_cache()
-        self.start_time = time.time()
+        self.start_time = time.time()          # wall, for display
+        self._start_mono = time.monotonic()    # durations (NTP-safe)
         self.cluster.start()
         await self.server.start()
 
@@ -306,6 +308,7 @@ class BioEngineWorker:
             "stop_profiling": self.stop_profiling,
             "memory_profile": self.memory_profile,
             "get_traces": self.get_traces,
+            "get_metrics": self.get_metrics,
             **self.code_executor.service_methods(),
         }
         assert self.apps_manager is not None
@@ -434,14 +437,40 @@ class BioEngineWorker:
         self,
         name: Optional[str] = None,
         max_spans: int = 200,
+        trace_id: Optional[str] = None,
+        include_open: bool = False,
         context: Optional[dict] = None,
-    ) -> list[dict]:
-        """Recent control-plane spans (deploys, replica placements —
-        utils/tracing.py), newest last. Admin-only."""
+    ) -> Any:
+        """Recent spans (control-plane events + sampled request
+        traces), newest last. With ``trace_id`` returns that request's
+        reconstructed cross-process span tree (remote spans arrive
+        piggybacked on RPC results) with a per-stage latency rollup.
+        Admin-only."""
         check_permissions(context, self.admin_users, "get_traces")
-        from bioengine_tpu.utils.tracing import get_spans
+        from bioengine_tpu.utils.tracing import build_trace_tree, get_spans
 
-        return get_spans(name=name, max_spans=max_spans)
+        if trace_id is not None:
+            return build_trace_tree(trace_id)
+        return get_spans(
+            name=name, max_spans=max_spans, include_open=include_open
+        )
+
+    def get_metrics(
+        self,
+        prometheus: bool = False,
+        context: Optional[dict] = None,
+    ) -> Any:
+        """The process-wide metrics registry (utils/metrics.py):
+        request latency histograms, transport counters, serving
+        gauges. ``prometheus=True`` returns the text exposition format
+        (the same body ``GET /metrics`` serves, unauthenticated, for
+        scrapers). Admin-only over RPC."""
+        check_permissions(context, self.admin_users, "get_metrics")
+        from bioengine_tpu.utils import metrics
+
+        if prometheus:
+            return metrics.render_prometheus()
+        return metrics.collect()
 
     def memory_profile(self, context: Optional[dict] = None) -> dict:
         """Device-memory snapshot (pprof-format bytes, base64) plus the
@@ -469,7 +498,9 @@ class BioEngineWorker:
     # ---- status / logs (ref worker.py:1034-1159) ----------------------------
 
     def get_status(self, context: Optional[dict] = None) -> dict:
-        uptime = time.time() - self.start_time if self.start_time else 0.0
+        uptime = (
+            time.monotonic() - self._start_mono if self._start_mono else 0.0
+        )
         apps = {}
         if self.apps_manager:
             try:

@@ -136,6 +136,7 @@ class WorkerHost:
                 "type": "bioengine-worker-host",
                 "config": {"require_context": False, "visibility": "protected"},
                 "describe": self.describe,
+                "get_metrics": self.get_metrics,
                 "start_replica": self.start_replica,
                 "replica_call": self.replica_call,
                 "replica_health": self.replica_health,
@@ -397,6 +398,17 @@ class WorkerHost:
         if replica is not None:
             await replica.stop()
         return {"replica_id": replica_id, "stopped": replica is not None}
+
+    def get_metrics(self, prometheus: bool = False) -> Any:
+        """This host process's metrics registry (replica latency
+        histograms, transport counters) — the controller can pull every
+        host's snapshot next to its own. Service is visibility:
+        protected, so only admin callers reach it."""
+        from bioengine_tpu.utils import metrics
+
+        if prometheus:
+            return metrics.render_prometheus()
+        return metrics.collect()
 
     def describe(self) -> dict:
         d = {

@@ -1,0 +1,69 @@
+"""Seeded BE-OBS-001 violations: wall-clock subtraction as a duration.
+
+Negative cases: monotonic deltas, timestamp arithmetic with constants,
+expiry comparisons, and wall time stored for display.
+"""
+
+import time
+
+
+def measures_duration_with_wall_clock():
+    started = time.time()
+    do_work()
+    return time.time() - started  # <- BE-OBS-001
+
+
+def subtracts_two_wall_timestamps():
+    t0 = time.time()
+    do_work()
+    t1 = time.time()
+    return t1 - t0  # <- BE-OBS-001
+
+
+class Tracker:
+    def __init__(self):
+        self.started_at = time.time()
+
+    def age(self):
+        return time.time() - self.started_at  # <- BE-OBS-001
+
+
+def direct_call_minus_foreign_attr(workload):
+    # one side is a direct time.time() call — flagged even though the
+    # other operand's origin is unknown
+    return time.time() - workload.submitted_at  # <- BE-OBS-001
+
+
+# ---- negative cases: none of these may fire -------------------------------
+
+
+def measures_duration_correctly():
+    t0 = time.monotonic()
+    do_work()
+    return time.monotonic() - t0
+
+
+def computes_past_timestamp():
+    # constant operand: a timestamp (an hour ago), not a duration
+    return time.time() - 3600
+
+
+def computes_expiry_deadline(ttl):
+    return time.time() + ttl
+
+
+def compares_against_deadline(expires_at):
+    return time.time() > expires_at
+
+
+def stores_wall_time_for_display():
+    record = {"started_at": time.time()}
+    return record
+
+
+def subtracts_unrelated_names(a, b):
+    return a - b
+
+
+def do_work():
+    pass

@@ -59,12 +59,13 @@ def test_every_rule_has_a_seeded_fixture_violation():
     seeded = set()
     for f in FIXTURE_FILES:
         seeded |= {rule for rule, _ in expected_markers(f)}
-    by_pass = {"async": set(), "jax": set()}
+    by_pass = {"async": set(), "jax": set(), "obs": set()}
     for r in all_rules():
         assert r.id in seeded, f"no fixture seeds a violation for {r.id}"
         by_pass[r.pass_name].add(r.id)
     assert len(by_pass["async"]) >= 4
     assert len(by_pass["jax"]) >= 4
+    assert len(by_pass["obs"]) >= 1
 
 
 def test_clean_fixture_is_clean():

@@ -155,6 +155,44 @@ def test_rpc_transport_stage_schema():
     assert st["big_roundtrip"]["chunked"]
 
 
+def test_observability_overhead_stage_schema():
+    """Pin the observability_overhead artifact schema: three interleaved
+    legs (disabled / unsampled / sampled) over the same live serve path,
+    per-leg p50 and the relative + absolute unsampled overhead. The <2%
+    acceptance number comes from the full-size driver run — a loaded CI
+    core would flake a hard threshold here, so the schema and sanity
+    ordering are the contract."""
+    proc, lines = _run(
+        {
+            "BENCH_CONFIGS": "observability_overhead",
+            "BENCH_DEADLINE": "170",
+            "BENCH_OBS_ROUNDS": "2",
+            "BENCH_OBS_REQUESTS": "25",
+        },
+        timeout=200.0,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    st = json.loads(lines[-1])["extra"]["observability_overhead"]
+    assert st["ok"], st
+    for key in (
+        "requests_per_leg",
+        "legs",
+        "overhead_unsampled_pct",
+        "overhead_unsampled_abs_us",
+        "overhead_sampled_pct",
+        "overhead_sampled_abs_us",
+    ):
+        assert key in st, key
+    assert st["requests_per_leg"] == 50
+    for leg in ("disabled", "unsampled", "sampled"):
+        assert st["legs"][leg]["p50_us"] > 0, leg
+    # full span recording can't be cheaper than the unsampled path's
+    # contextvar reads (sanity on the leg wiring, not a perf threshold)
+    assert (
+        st["overhead_sampled_abs_us"] >= st["overhead_unsampled_abs_us"] - 50
+    )
+
+
 def test_stalled_worker_killed_with_diagnostics_never_rc124():
     # the env-gated 'sleep' stage hangs mid-stage DETERMINISTICALLY (no
     # dependence on compile latency or a warm compilation cache), so a

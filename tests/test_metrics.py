@@ -1,0 +1,224 @@
+"""utils/metrics.py — registry, label children, histograms, collectors,
+thread-safety, and Prometheus text rendering."""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+
+import pytest
+
+from bioengine_tpu.utils import metrics
+from bioengine_tpu.utils.metrics import (
+    InstanceSet,
+    MetricsRegistry,
+    Sample,
+)
+
+# one sample line: name{labels} value  (labels optional)
+_LABEL_VALUE = r'"(\\.|[^"\\])*"'
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=" + _LABEL_VALUE
+    + r"(,[a-zA-Z_][a-zA-Z0-9_]*=" + _LABEL_VALUE + r")*\})?"
+    r" (-?\d+(\.\d+)?([eE][+-]?\d+)?|\+Inf|-Inf|NaN)$"
+)
+
+
+class TestFamilies:
+    def test_counter_labels_and_values(self):
+        reg = MetricsRegistry()
+        c = reg.counter("reqs_total", "requests", ("app", "outcome"))
+        c.labels("a", "ok").inc()
+        c.labels("a", "ok").inc(2)
+        c.labels("a", "err").inc()
+        assert c.labels("a", "ok").value == 3
+        assert c.labels("a", "err").value == 1
+        with pytest.raises(ValueError):
+            c.labels("a", "ok").inc(-1)
+        with pytest.raises(ValueError):
+            c.labels("only-one")
+
+    def test_reregistration_returns_same_family(self):
+        reg = MetricsRegistry()
+        a = reg.counter("x_total", "x", ("l",))
+        b = reg.counter("x_total", "x", ("l",))
+        assert a is b
+        with pytest.raises(ValueError):
+            reg.gauge("x_total")  # type change
+        with pytest.raises(ValueError):
+            reg.counter("x_total", "x", ("other",))  # schema change
+
+    def test_gauge_set_inc_dec(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("depth")
+        g.labels().set(5)
+        g.labels().inc()
+        g.labels().dec(2)
+        assert g.labels().value == 4
+
+    def test_histogram_buckets_and_quantiles(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "l", (), buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.005, 0.05, 0.5, 5.0):
+            h.observe(v)
+        snap = h.labels().snapshot()
+        assert snap["count"] == 5
+        # cumulative; string keys so the snapshot survives msgpack
+        assert snap["buckets"] == {"0.01": 2, "0.1": 3, "1": 4}
+        assert snap["p50"] == 0.1
+        assert snap["p99"] == math.inf  # overflow bucket
+        assert snap["sum"] == pytest.approx(5.56)
+
+    def test_histogram_empty_quantiles_none(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("empty_seconds", "l")
+        snap = h.labels().snapshot()
+        assert snap["count"] == 0 and snap["p50"] is None
+
+
+class TestConcurrency:
+    def test_concurrent_counter_and_histogram_mutation(self):
+        """Satellite: unlocked += would drop increments exactly under
+        load — 8 threads x 5000 ops must account exactly."""
+        reg = MetricsRegistry()
+        c = reg.counter("hits_total", "h", ("t",))
+        h = reg.histogram("obs_seconds", "o", (), buckets=(0.5,))
+        n_threads, per_thread = 8, 5000
+
+        def work(i):
+            child = c.labels(str(i % 2))
+            for k in range(per_thread):
+                child.inc()
+                h.observe(0.25 if k % 2 else 0.75)
+
+        threads = [
+            threading.Thread(target=work, args=(i,)) for i in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = c.labels("0").value + c.labels("1").value
+        assert total == n_threads * per_thread
+        snap = h.labels().snapshot()
+        assert snap["count"] == n_threads * per_thread
+        assert snap["buckets"]["0.5"] == n_threads * per_thread // 2
+
+
+class TestCollectors:
+    def test_collector_samples_in_collect_and_render(self):
+        reg = MetricsRegistry()
+        reg.register_collector(
+            "island",
+            lambda: [
+                Sample("island_bytes", 42, {"dir": "out"}, kind="counter")
+            ],
+        )
+        snap = reg.collect()
+        assert snap["island_bytes"]["series"] == [
+            {"labels": {"dir": "out"}, "value": 42}
+        ]
+        text = reg.render_prometheus()
+        assert 'bioengine_island_bytes{dir="out"} 42' in text
+
+    def test_bad_collector_never_breaks_scrape(self):
+        reg = MetricsRegistry()
+        reg.register_collector("boom", lambda: 1 / 0)
+        reg.counter("ok_total").inc()
+        assert "ok_total" in reg.collect()
+
+    def test_collector_registration_idempotent(self):
+        reg = MetricsRegistry()
+        reg.register_collector("a", lambda: [Sample("a_val", 1)])
+        reg.register_collector("a", lambda: [Sample("a_val", 2)])
+        (series,) = reg.collect()["a_val"]["series"]
+        assert series["value"] == 2
+
+    def test_instance_set_drops_dead_instances(self):
+        class Stats:
+            def __init__(self, n):
+                self.n = n
+
+        iset = InstanceSet(
+            "test_iset_gc",
+            lambda items: [Sample("iset_total", sum(i.n for i in items))],
+        )
+        a, b = Stats(1), Stats(2)
+        iset.add(a)
+        iset.add(b)
+        assert list(iset._collect())[0].value == 3
+        del b
+        import gc
+
+        gc.collect()
+        assert list(iset._collect())[0].value == 1
+        metrics.REGISTRY.unregister_collector("test_iset_gc")
+
+
+class TestPrometheusRendering:
+    def test_every_line_is_valid_exposition_format(self):
+        reg = MetricsRegistry()
+        c = reg.counter("r_total", "requests served", ("app",))
+        c.labels('we"ird\napp').inc()
+        h = reg.histogram("l_seconds", "latency", ("dep",), buckets=(0.1, 1))
+        h.labels("d1").observe(0.05)
+        g = reg.gauge("free")
+        g.set(3)
+        text = reg.render_prometheus()
+        assert text.endswith("\n")
+        for line in text.splitlines():
+            if line.startswith("#"):
+                assert line.startswith("# HELP") or line.startswith("# TYPE")
+                continue
+            assert _SAMPLE_RE.match(line), f"invalid sample line: {line!r}"
+
+    def test_histogram_rendering_contract(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("q_seconds", "", ("dep",), buckets=(0.1, 1.0))
+        h.labels("d").observe(0.05)
+        h.labels("d").observe(2.0)
+        text = reg.render_prometheus()
+        assert '# TYPE bioengine_q_seconds histogram' in text
+        assert 'bioengine_q_seconds_bucket{dep="d",le="0.1"} 1' in text
+        assert 'bioengine_q_seconds_bucket{dep="d",le="1"} 1' in text
+        assert 'bioengine_q_seconds_bucket{dep="d",le="+Inf"} 2' in text
+        assert 'bioengine_q_seconds_count{dep="d"} 2' in text
+        # bucket counts are cumulative and monotonic
+        counts = [
+            float(line.rsplit(" ", 1)[1])
+            for line in text.splitlines()
+            if line.startswith("bioengine_q_seconds_bucket")
+        ]
+        assert counts == sorted(counts)
+
+
+class TestProcessRegistry:
+    def test_default_registry_absorbs_stats_islands(self):
+        """RpcStats / PipelineStats register themselves at construction
+        — one live instance is enough for process totals to appear."""
+        from bioengine_tpu.rpc.transport import RpcStats
+        from bioengine_tpu.runtime.pipeline import PipelineStats
+
+        st = RpcStats()
+        with st.lock:
+            st.bytes_out += 123
+        ps = PipelineStats(depth=2)
+        ps.add(compute_seconds=1.5)
+        snap = metrics.collect()
+        assert any(
+            s["value"] >= 123 for s in snap["rpc_bytes_out"]["series"]
+        )
+        assert any(
+            s["value"] >= 1.5
+            for s in snap["pipeline_compute_seconds"]["series"]
+        )
+
+    def test_metrics_enabled_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("BIOENGINE_METRICS", "0")
+        metrics.reset_env_cache()
+        assert metrics.metrics_enabled() is False
+        monkeypatch.delenv("BIOENGINE_METRICS")
+        metrics.reset_env_cache()
+        assert metrics.metrics_enabled() is True

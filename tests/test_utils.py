@@ -291,12 +291,45 @@ class TestTracing:
         with span("outer", app_id="a"):
             with span("inner"):
                 pass
+        # spans land on the buffer when they OPEN (satellite: in-flight
+        # visibility), so the order is start order — outer first
         spans = get_spans()
-        assert [s["name"] for s in spans] == ["inner", "outer"]
-        inner, outer = spans
+        assert [s["name"] for s in spans] == ["outer", "inner"]
+        outer, inner = spans
         assert inner["parent_id"] == outer["span_id"]
         assert outer["attrs"] == {"app_id": "a"}
         assert outer["duration_s"] >= inner["duration_s"] >= 0
+
+    def test_open_spans_visible_only_with_include_open(self):
+        from bioengine_tpu.utils.tracing import clear_spans, get_spans, span
+
+        clear_spans()
+        with span("inflight"):
+            assert get_spans() == []  # not closed yet
+            (open_s,) = get_spans(include_open=True)
+            assert open_s["name"] == "inflight"
+            assert "duration_s" not in open_s
+        (closed,) = get_spans()
+        assert closed["duration_s"] >= 0
+
+    def test_duration_is_monotonic_not_wall(self, monkeypatch):
+        """A wall-clock step (NTP slew) must not corrupt durations;
+        started_at stays wall time for display."""
+        import time as _time
+
+        from bioengine_tpu.utils import tracing
+
+        tracing.clear_spans()
+        real_time = _time.time
+        with tracing.span("stepped"):
+            # jump the wall clock an hour back mid-span
+            monkeypatch.setattr(
+                _time, "time", lambda: real_time() - 3600.0
+            )
+        monkeypatch.undo()
+        (s,) = tracing.get_spans()
+        assert 0 <= s["duration_s"] < 1.0
+        assert abs(s["started_at"] - real_time()) < 5.0
 
     def test_span_failure_recorded_and_reraised(self):
         from bioengine_tpu.utils.tracing import clear_spans, get_spans, span
