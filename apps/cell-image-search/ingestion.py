@@ -65,8 +65,8 @@ def write_status(
     if path.exists():
         try:
             existing = json.loads(path.read_text())
-        except Exception:
-            pass
+        except (OSError, ValueError):
+            existing = {}  # unreadable/corrupt status: start fresh
     prev_log = existing.get("log_tail", [])
     if log_lines:
         prev_log = (prev_log + list(log_lines))[-20:]

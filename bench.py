@@ -1034,20 +1034,26 @@ def _bench_rpc_transport(cpu: bool) -> dict:
 
 def _bench_observability(cpu: bool) -> dict:  # noqa: ARG001 — pure host path
     """Per-request cost of the observability substrate on the serve
-    hot path. Three legs over the same live controller + replica
+    hot path. Four legs over the same live controller + replica
     (DeploymentHandle.call -> route -> semaphore -> execute, the path
     every request pays regardless of model):
 
-    - ``disabled``  — BIOENGINE_TRACING=0, BIOENGINE_METRICS=0 (the
-      PR-5 hot path: no context minted, no histogram observed)
-    - ``unsampled`` — production defaults: tracing on, head sampling
-      0.0, metrics on (the cost every *unsampled* request pays —
-      context mint + one contextvar read per span site + histogram
-      observes)
-    - ``sampled``   — sampling 1.0 (the ceiling: full span recording)
+    - ``disabled``  — BIOENGINE_TRACING=0, BIOENGINE_METRICS=0,
+      BIOENGINE_FLIGHT=0 (the PR-5 hot path: no context minted, no
+      histogram observed, no flight ring)
+    - ``unsampled`` — tracing on, head sampling 0.0, metrics on,
+      flight OFF (the PR-6 production default — the baseline the
+      flight leg is judged against)
+    - ``flight``    — unsampled + the always-on flight recorder (the
+      PR-7 production default; the acceptance gate reads
+      ``overhead_flight_vs_unsampled_pct`` < 1 — the ring writes only
+      on failure/transition edges, so the per-request cost is the
+      enabled-checks)
+    - ``sampled``   — sampling 1.0 (the ceiling: full span recording
+      + chip-seconds stamped on the trace root)
 
     Legs interleave round-robin so clock drift and CPU contention hit
-    all three equally; per-leg p50 comes from the pooled per-request
+    all of them equally; per-leg p50 comes from the pooled per-request
     times. The acceptance gate reads ``overhead_unsampled_pct``.
     """
     import asyncio
@@ -1056,7 +1062,7 @@ def _bench_observability(cpu: bool) -> dict:  # noqa: ARG001 — pure host path
 
     from bioengine_tpu.cluster.state import ClusterState
     from bioengine_tpu.serving import DeploymentSpec, ServeController
-    from bioengine_tpu.utils import metrics, tracing
+    from bioengine_tpu.utils import flight, metrics, tracing
 
     rounds = int(os.environ.get("BENCH_OBS_ROUNDS", "5"))
     per_round = int(os.environ.get("BENCH_OBS_REQUESTS", "60"))
@@ -1077,11 +1083,24 @@ def _bench_observability(cpu: bool) -> dict:  # noqa: ARG001 — pure host path
             return float((self._x @ self._x).sum())
 
     legs = {
-        "disabled": {"BIOENGINE_TRACING": "0", "BIOENGINE_METRICS": "0"},
-        "unsampled": {"BIOENGINE_TRACE_SAMPLE": "0.0"},
+        "disabled": {
+            "BIOENGINE_TRACING": "0",
+            "BIOENGINE_METRICS": "0",
+            "BIOENGINE_FLIGHT": "0",
+        },
+        "unsampled": {
+            "BIOENGINE_TRACE_SAMPLE": "0.0",
+            "BIOENGINE_FLIGHT": "0",
+        },
+        "flight": {"BIOENGINE_TRACE_SAMPLE": "0.0"},
         "sampled": {"BIOENGINE_TRACE_SAMPLE": "1.0"},
     }
-    knobs = ["BIOENGINE_TRACING", "BIOENGINE_METRICS", "BIOENGINE_TRACE_SAMPLE"]
+    knobs = [
+        "BIOENGINE_TRACING",
+        "BIOENGINE_METRICS",
+        "BIOENGINE_TRACE_SAMPLE",
+        "BIOENGINE_FLIGHT",
+    ]
 
     def _apply(env: dict) -> None:
         for k in knobs:
@@ -1089,6 +1108,7 @@ def _bench_observability(cpu: bool) -> dict:  # noqa: ARG001 — pure host path
         os.environ.update(env)
         tracing.reset_env_cache()
         metrics.reset_env_cache()
+        flight.reset_env_cache()
 
     async def run() -> dict:
         controller = ServeController(ClusterState(), health_check_period=3600)
@@ -1120,6 +1140,7 @@ def _bench_observability(cpu: bool) -> dict:  # noqa: ARG001 — pure host path
                     os.environ[k] = v
             tracing.reset_env_cache()
             metrics.reset_env_cache()
+            flight.reset_env_cache()
             await controller.stop()
 
         def p50_us(vals: list) -> float:
@@ -1130,16 +1151,26 @@ def _bench_observability(cpu: bool) -> dict:  # noqa: ARG001 — pure host path
             "legs": {name: {"p50_us": p50_us(v)} for name, v in times.items()},
         }
         base = out["legs"]["disabled"]["p50_us"]
-        for name in ("unsampled", "sampled"):
+        for name in ("unsampled", "flight", "sampled"):
             leg = out["legs"][name]["p50_us"]
             out[f"overhead_{name}_pct"] = round(100.0 * (leg - base) / base, 2)
             out[f"overhead_{name}_abs_us"] = round(leg - base, 1)
+        # the flight-recorder acceptance gate: the always-on ring vs
+        # the PR-6 unsampled baseline (its own leg, flight off)
+        unsampled = out["legs"]["unsampled"]["p50_us"]
+        flight_leg = out["legs"]["flight"]["p50_us"]
+        out["overhead_flight_vs_unsampled_pct"] = round(
+            100.0 * (flight_leg - unsampled) / unsampled, 2
+        )
         out["note"] = (
-            "unsampled = production default (tracing on, 0% head "
-            "sampling, metrics on); overhead vs the fully-disabled "
-            "PR-5 hot path must sit within measurement noise (<2%). "
-            "abs_us is workload-independent — the per-request cost of "
-            "the substrate itself"
+            "unsampled = PR-6 default (tracing on, 0% head sampling, "
+            "metrics on, flight ring off); flight = that plus the "
+            "always-on flight recorder (PR-7 default, gate: "
+            "overhead_flight_vs_unsampled_pct < 1 — the ring only "
+            "writes on failure/transition edges); overhead vs the "
+            "fully-disabled PR-5 hot path must sit within measurement "
+            "noise (<2%). abs_us is workload-independent — the "
+            "per-request cost of the substrate itself"
         )
         return out
 

@@ -11,6 +11,16 @@ fields, token expiry deadlines, display ages cross-referenced against
 logged wall times); those sites suppress with
 ``# bioengine: ignore[BE-OBS-001]`` and a justification, like any
 other rule.
+
+BE-OBS-002 flags the other way telemetry lies: a broad exception
+handler (bare ``except:``, ``except Exception:``,
+``except BaseException:``) whose entire body is ``pass`` — the failure
+happened, left no log line, no flight-recorder event, no re-raise, and
+the postmortem reads "everything was fine". Narrow handlers
+(``except OSError: pass``) stay legal: catching a *specific* expected
+condition and ignoring it is a decision the type spells out. Broad
+silent swallows that are genuinely deliberate (close-paths racing a
+peer's teardown) get a baseline entry with a justification.
 """
 
 from __future__ import annotations
@@ -36,7 +46,57 @@ WALL_CLOCK_DURATION = register_rule(
     )
 )
 
+SILENT_SWALLOW = register_rule(
+    Rule(
+        "BE-OBS-002",
+        "silent-swallow",
+        "broad except whose body is only `pass` — swallows without "
+        "logging or re-raising",
+        "obs",
+    )
+)
+
 _WALL_CALLS = {"time.time"}
+
+# handler types broad enough that silently discarding them hides bugs;
+# a narrow type (OSError, StopIteration, asyncio.TimeoutError) names
+# the expected condition and may be ignored deliberately
+_BROAD_EXC = {"Exception", "BaseException", "builtins.Exception",
+              "builtins.BaseException"}
+
+
+def _body_is_only_pass(body: list[ast.stmt]) -> bool:
+    """True when the handler does literally nothing: ``pass`` and/or
+    bare ``...`` statements only (a docstring or log call disqualifies)."""
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis
+        ):
+            continue
+        return False
+    return True
+
+
+def _iter_silent_swallows(tree: ast.Module) -> Iterator[ast.ExceptHandler]:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _body_is_only_pass(node.body):
+            continue
+        if node.type is None:  # bare `except:` — broader than broad
+            yield node
+            continue
+        types = (
+            node.type.elts
+            if isinstance(node.type, ast.Tuple)
+            else [node.type]
+        )
+        if any(dotted_name(t) in _BROAD_EXC for t in types):
+            yield node
 
 
 def _is_wall_call(node: ast.AST) -> bool:
@@ -90,6 +150,15 @@ def run_obs_pass(ctx: ModuleContext) -> Iterator[Finding]:
                 "NTP slew — measure with `time.monotonic()` and keep "
                 "wall time only for displayed timestamps",
             )
+
+    for handler in _iter_silent_swallows(ctx.tree):
+        yield ctx.finding(
+            SILENT_SWALLOW.id,
+            handler,
+            "broad exception swallowed silently: log it (at least "
+            "debug), record a flight event, re-raise, or narrow the "
+            "type — a deliberate swallow needs a baseline justification",
+        )
 
 
 register_pass("obs", run_obs_pass)

@@ -15,6 +15,7 @@ from bioengine_tpu.cli.analyze import analyze_command
 from bioengine_tpu.cli.apps import apps_group
 from bioengine_tpu.cli.call import call_command
 from bioengine_tpu.cli.cluster import cluster_group
+from bioengine_tpu.cli.debug import debug_group
 from bioengine_tpu.cli.models import models_group
 
 
@@ -28,6 +29,7 @@ main.add_command(analyze_command)
 main.add_command(call_command)
 main.add_command(apps_group)
 main.add_command(cluster_group)
+main.add_command(debug_group)
 main.add_command(models_group)
 
 

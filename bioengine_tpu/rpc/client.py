@@ -23,7 +23,7 @@ from bioengine_tpu.rpc.transport import (
     attach_store_by_name,
 )
 from bioengine_tpu.testing import faults
-from bioengine_tpu.utils import tracing
+from bioengine_tpu.utils import flight, tracing
 from bioengine_tpu.utils.backoff import full_jitter_delay
 from bioengine_tpu.utils.logger import create_logger
 from bioengine_tpu.utils.tasks import spawn_supervised
@@ -151,13 +151,13 @@ class ServerConnection:
         if self._ws is not None and not self._ws.closed:
             try:
                 await self._ws.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001 — remnant of a dead transport
+                self.logger.debug(f"stale ws close raised: {e}")
         if self._session is not None:
             try:
                 await self._session.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001 — remnant of a dead transport
+                self.logger.debug(f"stale session close raised: {e}")
         self._ws = None
         self._session = None
 
@@ -288,6 +288,13 @@ class ServerConnection:
         if self._closing:
             return
         self.logger.warning("connection to server lost")
+        flight.record(
+            "client.disconnect",
+            severity="warning",
+            url=self.url,
+            client_id=self.client_id,
+            in_flight=len(self._pending),
+        )
         self._fail_inflight(
             ConnectionLost(f"connection to {self.url} lost mid-call")
         )
@@ -347,6 +354,12 @@ class ServerConnection:
                 )
                 continue
             self.logger.info(f"reconnected after {attempt} attempt(s)")
+            flight.record(
+                "client.reconnect",
+                url=self.url,
+                client_id=self.client_id,
+                attempts=attempt,
+            )
             return
 
     async def _reregister_services(self) -> None:

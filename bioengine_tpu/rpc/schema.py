@@ -40,10 +40,7 @@ def extract_schema(func: Callable) -> dict[str, Any]:
         if hint in _TYPE_MAP:
             prop["type"] = _TYPE_MAP[hint]
         if param.default is not inspect.Parameter.empty:
-            try:
-                prop["default"] = param.default
-            except Exception:
-                pass
+            prop["default"] = param.default
         else:
             if param.kind not in (
                 inspect.Parameter.VAR_POSITIONAL,

@@ -660,8 +660,8 @@ class RpcServer:
         if probe is not None and self._shm_store is not None:
             try:
                 self._shm_store.delete(probe[0])
-            except Exception:  # noqa: BLE001 — client may have deleted it
-                pass
+            except Exception as e:  # noqa: BLE001 — client may have deleted it
+                self.logger.debug(f"probe cleanup raced: {e}")
         for full_id in [
             fid
             for fid, e in self._services.items()
@@ -711,8 +711,8 @@ class RpcServer:
             if probe is not None and self._shm_store is not None:
                 try:
                     self._shm_store.delete(probe[0])
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e:  # noqa: BLE001 — client may have deleted it
+                    self.logger.debug(f"probe cleanup raced: {e}")
             await self._send(
                 ws,
                 codec,

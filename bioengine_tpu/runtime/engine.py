@@ -298,11 +298,27 @@ class InferenceEngine:
             except Exception:  # noqa: BLE001 — stats never break status
                 pass
             per_chip[str(d.id)] = entry
+        # per-program compile cost: this engine's slice of the (shared)
+        # program cache — entries are keyed by model_id, so filter to
+        # ours. The lifetime totals live on cache.stats / the
+        # program_cache_* metrics; this is the per-program breakdown an
+        # operator reads next to HBM residency when profiling one
+        # replica of a live deployment.
+        mine = {
+            k: round(v, 3)
+            for k, v in self.cache.compile_seconds_snapshot().items()
+            if k.startswith(f"('{self.model_id}'")
+        }
         return {
             "device_ids": [d.id for d in self.devices],
             "n_devices": len(self.devices),
             "mesh": self.mesh_shape,
             "per_chip": per_chip,
+            "programs": {
+                "live": len(mine),
+                "compile_seconds": mine,
+                "cache_hit_rate": self.cache.stats_dict()["hit_rate"],
+            },
         }
 
     def close(self) -> None:
@@ -413,27 +429,42 @@ class InferenceEngine:
         ``engine.predict`` span whose attrs carry the PipelineStats
         per-stage delta (h2d put / dispatch / compute / readback /
         stitch seconds) — the device-side half of the request's latency
-        breakdown. Unsampled requests skip all of it."""
+        breakdown — plus the prediction's ``chip_seconds`` (wall
+        seconds x mesh width). Chip-seconds ALSO feed the request-
+        scoped accounting accumulator (utils/tracing.py) on every
+        call, sampled or not: cost is exact, only spans are sampled."""
         ctx = tracing.current_trace()
+        width = len(self.devices)
+        t0 = time.monotonic()
         if ctx is None or not ctx.sampled:
-            return self._predict_impl(images)
+            try:
+                return self._predict_impl(images)
+            finally:
+                tracing.add_chip_seconds((time.monotonic() - t0) * width)
         before = self.pipeline_stats.as_dict()
-        with tracing.span(
-            "engine.predict",
-            model=self.model_id,
-            batch=int(np.asarray(images).shape[0]),
-            mesh=self._mesh_key,
-        ) as record:
-            out = self._predict_impl(images)
-            after = self.pipeline_stats.as_dict()
-            record["attrs"]["stage_seconds"] = {
-                k.removesuffix("_seconds"): round(after[k] - before[k], 6)
-                for k in (
-                    "cut_seconds", "put_seconds", "dispatch_seconds",
-                    "compute_seconds", "readback_seconds", "stitch_seconds",
+        try:
+            with tracing.span(
+                "engine.predict",
+                model=self.model_id,
+                batch=int(np.asarray(images).shape[0]),
+                mesh=self._mesh_key,
+                devices=width,
+            ) as record:
+                out = self._predict_impl(images)
+                after = self.pipeline_stats.as_dict()
+                record["attrs"]["stage_seconds"] = {
+                    k.removesuffix("_seconds"): round(after[k] - before[k], 6)
+                    for k in (
+                        "cut_seconds", "put_seconds", "dispatch_seconds",
+                        "compute_seconds", "readback_seconds", "stitch_seconds",
+                    )
+                }
+                record["attrs"]["chip_seconds"] = round(
+                    (time.monotonic() - t0) * width, 6
                 )
-            }
-        return out
+            return out
+        finally:
+            tracing.add_chip_seconds((time.monotonic() - t0) * width)
 
     def _predict_impl(self, images: np.ndarray) -> np.ndarray:
         images = self._validate(images)

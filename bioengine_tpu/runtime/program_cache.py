@@ -18,6 +18,8 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Callable, Hashable, Optional
 
+from bioengine_tpu.utils import flight, metrics
+
 
 @dataclass
 class CacheStats:
@@ -43,6 +45,51 @@ class CacheStats:
         }
 
 
+def _collect_program_caches(instances: list) -> list:
+    """Scrape-time fold of live program caches into process metrics:
+    compile time is the cold-start cost (ROADMAP item 3) and the reason
+    a request's p99 suddenly grows a 30 s tail — it belongs on the
+    dashboard next to the latency histograms it explains."""
+    hits = misses = evictions = 0
+    compile_s = 0.0
+    live = 0
+    for c in instances:
+        s = c.stats
+        hits += s.hits
+        misses += s.misses
+        evictions += s.evictions
+        compile_s += s.cumulative_compile_seconds
+        live += len(c)
+    return [
+        metrics.Sample(
+            "program_cache_hits_total", hits, kind="counter",
+            help="compiled-program cache hits",
+        ),
+        metrics.Sample(
+            "program_cache_misses_total", misses, kind="counter",
+            help="compiled-program cache misses (each cost a compile)",
+        ),
+        metrics.Sample(
+            "program_cache_evictions_total", evictions, kind="counter",
+            help="compiled programs evicted (a re-request recompiles)",
+        ),
+        metrics.Sample(
+            "program_cache_compile_seconds_total", round(compile_s, 6),
+            kind="counter",
+            help="lifetime XLA compile seconds across caches",
+        ),
+        metrics.Sample(
+            "program_cache_live_programs", live,
+            help="compiled programs currently cached",
+        ),
+    ]
+
+
+_PROGRAM_CACHES = metrics.InstanceSet(
+    "program_cache", _collect_program_caches
+)
+
+
 class CompiledProgramCache:
     """Bounded LRU of compiled XLA programs.
 
@@ -58,6 +105,7 @@ class CompiledProgramCache:
         self._building: dict[Hashable, threading.Event] = {}
         self._lock = threading.Lock()
         self.stats = CacheStats()
+        _PROGRAM_CACHES.add(self)
 
     def get_or_compile(self, key: Hashable, build: Callable[[], Any]) -> Any:
         while True:
@@ -75,6 +123,7 @@ class CompiledProgramCache:
             t0 = time.perf_counter()
             program = build()
             dt = time.perf_counter() - t0
+            evicted = []
             with self._lock:
                 self.stats.misses += 1
                 self.stats.compile_seconds[str(key)] = dt
@@ -85,10 +134,29 @@ class CompiledProgramCache:
                     victim, _ = self._programs.popitem(last=False)
                     self.stats.compile_seconds.pop(str(victim), None)
                     self.stats.evictions += 1
+                    evicted.append(victim)
+            flight.record(
+                "program.compile", key=str(key), seconds=round(dt, 3)
+            )
+            for victim in evicted:
+                flight.record("program.evict", key=str(victim))
             return program
         finally:
             with self._lock:
                 self._building.pop(key).set()
+
+    def compile_seconds_snapshot(self) -> dict:
+        """Copy of per-key compile seconds under the cache lock —
+        readers (engine.describe) must not iterate the live dict while
+        a compile on the dispatch thread inserts/evicts."""
+        with self._lock:
+            return dict(self.stats.compile_seconds)
+
+    def stats_dict(self) -> dict:
+        """``stats.as_dict()`` under the cache lock (it sums the live
+        compile_seconds dict, which mutates under this lock)."""
+        with self._lock:
+            return self.stats.as_dict()
 
     def get(self, key: Hashable) -> Optional[Any]:
         with self._lock:
@@ -106,7 +174,9 @@ class CompiledProgramCache:
                 del self._programs[k]
                 self.stats.compile_seconds.pop(str(k), None)
             self.stats.evictions += len(victims)
-            return len(victims)
+        for k in victims:
+            flight.record("program.evict", key=str(k))
+        return len(victims)
 
     def keys(self) -> list[Hashable]:
         with self._lock:

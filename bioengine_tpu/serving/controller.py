@@ -37,11 +37,12 @@ from bioengine_tpu.serving.errors import (
 )
 from bioengine_tpu.serving.remote import RemoteReplica
 from bioengine_tpu.serving.replica import (
+    CHIP_SECONDS,
     ROUTABLE_STATES,
     Replica,
     ReplicaState,
 )
-from bioengine_tpu.utils import metrics, tracing
+from bioengine_tpu.utils import flight, metrics, tracing
 from bioengine_tpu.utils.backoff import full_jitter_delay
 from bioengine_tpu.utils.logger import create_logger
 
@@ -285,10 +286,23 @@ class DeploymentHandle:
                     deployment=self.deployment,
                     method=method,
                     trace_root=parent is None,
-                ):
-                    return await self._call_attempts(
+                ) as record:
+                    result = await self._call_attempts(
                         method, args, kwargs, options
                     )
+                    # per-request device cost on the TRACE ROOT: the sum
+                    # of every engine.predict under this trace_id (local
+                    # spans plus the ones absorbed off RESULT frames),
+                    # each already engine wall-seconds x mesh width.
+                    # Nested composition spans don't stamp — the whole
+                    # trace's cost belongs to exactly one root.
+                    if parent is None:
+                        cs = tracing.trace_attr_sum(
+                            ctx.trace_id, "engine.predict", "chip_seconds"
+                        )
+                        if cs:
+                            record["attrs"]["chip_seconds"] = round(cs, 6)
+                    return result
             return await self._call_attempts(method, args, kwargs, options)
         except Exception as e:
             kind = classify_exception(e)
@@ -296,6 +310,24 @@ class DeploymentHandle:
                 FailureKind.APPLICATION: "app_error",
                 FailureKind.DEADLINE: "deadline",
             }.get(kind, "transport_error")
+            if kind is FailureKind.DEADLINE:
+                # the evidence of WHY the budget was blown (breaker
+                # trips, re-placements, parks) is in the ring right now
+                # — snapshot it before it wraps
+                flight.record(
+                    "deadline.exceeded",
+                    severity="error",
+                    app=self.app_id,
+                    deployment=self.deployment,
+                    method=method,
+                    trace_id=ctx.trace_id if ctx else None,
+                    error=str(e)[:500],
+                )
+                flight.dump(
+                    "deadline_exceeded",
+                    app=self.app_id,
+                    deployment=self.deployment,
+                )
             raise
         finally:
             duration = time.monotonic() - t0
@@ -327,6 +359,16 @@ class DeploymentHandle:
                     f"duration_ms={duration * 1000.0:.1f} "
                     f"outcome={outcome} "
                     f"sampled={ctx.sampled if ctx else False}"
+                )
+                flight.record(
+                    "request.slow",
+                    severity="warning",
+                    app=self.app_id,
+                    deployment=self.deployment,
+                    method=method,
+                    duration_ms=round(duration * 1000.0, 1),
+                    outcome=outcome,
+                    trace_id=ctx.trace_id if ctx else None,
                 )
 
     async def _call_attempts(
@@ -425,6 +467,16 @@ class DeploymentHandle:
                     ) from e
                 if metrics.metrics_enabled():
                     REQUEST_FAILOVERS.labels(self.app_id, self.deployment).inc()
+                flight.record(
+                    "request.failover",
+                    severity="warning",
+                    app=self.app_id,
+                    deployment=self.deployment,
+                    method=method,
+                    replica=replica.replica_id,
+                    attempt=attempt,
+                    error=str(e)[:300],
+                )
                 # exponential backoff with FULL jitter, clamped to the
                 # remaining deadline budget
                 delay = full_jitter_delay(
@@ -551,6 +603,14 @@ class ServeController:
             )
             if replicas:
                 self._replicas_changed.set()
+            flight.record(
+                "host.join",
+                host=host_id,
+                service_id=service_id,
+                chips=topology.get("n_chips", 0),
+                warm_replicas=len(replicas or []),
+                dropped=len(drop_replicas),
+            )
             return {
                 "host_id": host_id,
                 "registered": True,
@@ -701,6 +761,14 @@ class ServeController:
         app.replicas[spec.name].append(replica)
         self.cluster_state.remove_pending(f"{app.app_id}/{spec.name}")
         self._replicas_changed.set()  # wake requests parked in _pick_replica_wait
+        flight.record(
+            "replica.place",
+            replica=replica.replica_id,
+            app=app.app_id,
+            deployment=spec.name,
+            host=host_id,
+            device_ids=list(replica.device_ids),
+        )
         return replica
 
     def _readopt_replica(
@@ -742,6 +810,13 @@ class ServeController:
             self.logger.info(
                 f"re-adopted warm replica {r.replica_id} on rejoined "
                 f"host '{host_id}' (state={reported})"
+            )
+            flight.record(
+                "replica.readopt",
+                replica=r.replica_id,
+                app=app.app_id,
+                host=host_id,
+                state=reported.value,
             )
             return True
         return False
@@ -904,10 +979,29 @@ class ServeController:
                 BREAKER_TRIPS.labels(
                     replica.app_id, replica.deployment_name
                 ).inc()
+            flight.record(
+                "breaker.trip",
+                severity="error",
+                replica=rid,
+                app=replica.app_id,
+                deployment=replica.deployment_name,
+                host=getattr(replica, "host_id", None),
+                failures=n,
+                error=str(exc)[:500],
+            )
+            # the postmortem moment: snapshot the ring while the events
+            # leading up to the trip are still in it
+            flight.dump("breaker_trip", replica=rid, app=replica.app_id)
             self._wake_health.set()
 
     def _breaker_success(self, replica) -> None:
-        self._breaker_counts.pop(replica.replica_id, None)
+        if self._breaker_counts.pop(replica.replica_id, None):
+            flight.record(
+                "breaker.reset",
+                replica=replica.replica_id,
+                app=replica.app_id,
+                deployment=replica.deployment_name,
+            )
 
     # ---- health + autoscaling loop ------------------------------------------
 
@@ -1035,6 +1129,12 @@ class ServeController:
                     f"host '{host.host_id}' lost "
                     f"(orphaned replicas: {orphans})"
                 )
+                flight.record(
+                    "host.dead",
+                    severity="error",
+                    host=host.host_id,
+                    orphaned_replicas=list(orphans),
+                )
 
     async def _autoscale(self, app: AppDeployment, spec: DeploymentSpec) -> None:
         if not spec.autoscale:
@@ -1090,10 +1190,36 @@ class ServeController:
             "app_id": app_id,
             "status": app.status,
             "created_at": app.created_at,
+            "cost": self._cost_rollup(app_id),
             "deployments": {
                 name: self._describe_deployment(app_id, name, replicas)
                 for name, replicas in app.replicas.items()
             },
+        }
+
+    def _cost_rollup(self, app_id: str) -> dict:
+        """Per-app chip-seconds from the process registry — the feature
+        vector the future scheduler consumes (ROADMAP item 1). Replicas
+        in THIS process (local placement, or the in-process multi-host
+        harness) account here; separate worker-host processes surface
+        their slice via their own ``get_metrics``/``get_flight_record``
+        and the incident bundle."""
+        total = 0.0
+        by_dep: dict[str, dict] = {}
+        for key, child in CHIP_SECONDS.items():
+            a, dep, method = key
+            if a != app_id:
+                continue
+            v = child.value
+            total += v
+            d = by_dep.setdefault(
+                dep, {"chip_seconds_total": 0.0, "by_method": {}}
+            )
+            d["chip_seconds_total"] = round(d["chip_seconds_total"] + v, 6)
+            d["by_method"][method] = round(v, 6)
+        return {
+            "chip_seconds_total": round(total, 6),
+            "by_deployment": by_dep,
         }
 
     def _describe_deployment(self, app_id, name, replicas) -> dict:
@@ -1127,6 +1253,89 @@ class ServeController:
                 for d in described
                 if d.get("mesh")
             },
+        }
+
+    async def debug_bundle(
+        self,
+        event_limit: int = 2000,
+        max_spans: int = 1000,
+        host_timeout_s: float = 10.0,
+    ) -> dict:
+        """One time-merged incident artifact: this process's flight
+        record, recent traces, and metrics snapshot, plus the flight
+        record + metrics + describe (topology, replica/mesh state) of
+        every REACHABLE worker host, with all flight events folded into
+        a single wall-clock-ordered timeline (deduped by recorder
+        identity, so an in-process harness where hosts share this
+        process's ring never double-reports). Unreachable hosts are
+        reported as such instead of failing the bundle — the hosts you
+        can't reach are usually the ones the incident is about."""
+        local_rec = flight.get_record(limit=event_limit)
+        records = [local_rec]
+        hosts_out: dict[str, Any] = {}
+
+        async def gather_host(host) -> None:
+            # the three verbs (and the hosts) are independent — run
+            # them concurrently so a cluster with several wedged hosts
+            # costs ONE timeout, not hosts x verbs of them; the bundle
+            # is the tool an operator reaches for mid-incident
+            try:
+                rec, met, desc = await asyncio.gather(
+                    self._call_host(
+                        host.service_id,
+                        "get_flight_record",
+                        limit=event_limit,
+                        rpc_timeout=host_timeout_s,
+                    ),
+                    self._call_host(
+                        host.service_id, "get_metrics",
+                        rpc_timeout=host_timeout_s,
+                    ),
+                    self._call_host(
+                        host.service_id, "describe",
+                        rpc_timeout=host_timeout_s,
+                    ),
+                )
+                records.append(rec)
+                hosts_out[host.host_id] = {
+                    "reachable": True,
+                    "recorder": rec.get("recorder"),
+                    "flight_events": len(rec.get("events", []) or []),
+                    "dumps": rec.get("dumps", []),
+                    "metrics": met,
+                    "describe": desc,
+                }
+            except Exception as e:  # noqa: BLE001 — partial bundle beats none
+                hosts_out[host.host_id] = {
+                    "reachable": False,
+                    "reason": f"{type(e).__name__}: {e}",
+                }
+
+        live_hosts = []
+        for host in list(self.cluster_state.hosts.values()):
+            if host.alive:
+                live_hosts.append(host)
+            else:
+                hosts_out[host.host_id] = {
+                    "reachable": False,
+                    "reason": "marked dead",
+                }
+        await asyncio.gather(*(gather_host(h) for h in live_hosts))
+        return {
+            "generated_at": time.time(),
+            "recorder": local_rec["recorder"],
+            "events": flight.merge_records(records),
+            "dumps": local_rec["dumps"],
+            "traces": tracing.get_spans(
+                max_spans=max_spans, include_open=True
+            ),
+            "metrics": metrics.collect(),
+            "cluster": self.cluster_state.snapshot(),
+            "apps": {
+                app_id: self.get_app_status(app_id)
+                for app_id in list(self.apps)
+            },
+            "hosts": hosts_out,
         }
 
     def list_apps(self) -> list[str]:

@@ -31,11 +31,12 @@ from bioengine_tpu.serving.replica import (
     DEFAULT_DRAIN_TIMEOUT_S,
     ROUTABLE_STATES,
     ReplicaState,
+    ReplicaStateMixin,
 )
-from bioengine_tpu.utils import tracing
+from bioengine_tpu.utils import flight, tracing
 
 
-class RemoteReplica:
+class RemoteReplica(ReplicaStateMixin):
     is_remote = True
 
     def __init__(
@@ -128,6 +129,14 @@ class RemoteReplica:
         if self.state in ROUTABLE_STATES + (ReplicaState.INITIALIZING,):
             self.state = ReplicaState.DRAINING
             self._log(f"draining ({self._ongoing} in-flight)")
+            flight.record(
+                "replica.drain",
+                replica=self.replica_id,
+                app=self.app_id,
+                deployment=self.deployment_name,
+                host=self.host_id,
+                in_flight=self._ongoing,
+            )
         timeout = self.drain_timeout_s if timeout_s is None else timeout_s
         started = time.monotonic()
         try:
@@ -140,8 +149,8 @@ class RemoteReplica:
                 ),
                 timeout=timeout + 5.0,
             )
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — a dead host has trivially drained
+            self._log(f"host-side drain failed (tolerated): {e}")
         # calls routed through THIS object (the only routing path) must
         # also settle before the replica is torn down — on whatever is
         # LEFT of the one drain budget, not a second full helping
@@ -170,8 +179,8 @@ class RemoteReplica:
                 ),
                 timeout=15.0,
             )
-        except Exception:
-            pass  # host already gone is a fine way to be stopped
+        except Exception as e:  # noqa: BLE001 — host already gone is stopped
+            self._log(f"host-side stop failed (tolerated): {e}")
         self._log("remote replica stopped")
 
     # ---- request path -------------------------------------------------------

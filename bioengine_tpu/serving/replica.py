@@ -27,7 +27,7 @@ import uuid
 from typing import Any, Callable, Optional
 
 from bioengine_tpu.serving.errors import ReplicaUnavailableError
-from bioengine_tpu.utils import metrics, tracing
+from bioengine_tpu.utils import flight, metrics, tracing
 from bioengine_tpu.utils.logger import create_logger
 
 DEFAULT_DRAIN_TIMEOUT_S = float(
@@ -52,6 +52,16 @@ REPLICA_PARK = metrics.histogram(
     "time a call waited on the replica's request semaphore",
     ("app", "deployment", "replica"),
 )
+# the cost feature the future scheduler consumes (ROADMAP item 1):
+# device-seconds per request = engine wall seconds x mesh width,
+# accumulated HOST-side where the replica executes (utils/tracing.py
+# chip accumulator; engines feed it from predict). Always on — this is
+# accounting, not optional telemetry.
+CHIP_SECONDS = metrics.counter(
+    "chip_seconds_total",
+    "device-seconds consumed serving requests (engine wall time x mesh width)",
+    ("app", "deployment", "method"),
+)
 
 
 class ReplicaState(str, enum.Enum):
@@ -67,7 +77,37 @@ class ReplicaState(str, enum.Enum):
 ROUTABLE_STATES = (ReplicaState.HEALTHY, ReplicaState.TESTING)
 
 
-class Replica:
+class ReplicaStateMixin:
+    """``state`` as a flight-recorded property: every lifecycle
+    transition (including ones assigned from the controller — breaker
+    ejections, drains) lands in the postmortem ring with from/to and
+    the replica's identity. Shared by :class:`Replica` and
+    :class:`bioengine_tpu.serving.remote.RemoteReplica` so local and
+    remote replicas leave the same evidence trail."""
+
+    _state: Optional[ReplicaState] = None
+
+    @property
+    def state(self) -> ReplicaState:
+        return self._state
+
+    @state.setter
+    def state(self, value: ReplicaState) -> None:
+        old = self._state
+        self._state = value
+        if old is None or old == value:
+            return
+        flight.record(
+            "replica.state",
+            replica=getattr(self, "replica_id", "?"),
+            app=getattr(self, "app_id", "?"),
+            deployment=getattr(self, "deployment_name", "?"),
+            host=getattr(self, "host_id", None),
+            **{"from": old.value, "to": value.value},
+        )
+
+
+class Replica(ReplicaStateMixin):
     def __init__(
         self,
         app_id: str,
@@ -98,6 +138,10 @@ class Replica:
         self._requests_total: Optional[metrics.CounterChild] = None
         self._m_latency: Optional[metrics.HistogramChild] = None
         self._m_park: Optional[metrics.HistogramChild] = None
+        # chip-seconds accounting: per-method counter children (labels
+        # resolved once) + a replica-lifetime total describe() reads
+        self._m_chip: dict[str, metrics.CounterChild] = {}
+        self._chip_seconds = 0.0
         self._test_task: Optional[asyncio.Task] = None
         self._test_error: Optional[str] = None
         self._init_done = False
@@ -157,6 +201,16 @@ class Replica:
             self.last_error = "".join(traceback.format_exception(e))[-2000:]
             self.state = ReplicaState.UNHEALTHY
             self._log(f"replica start failed: {e}")
+            flight.record(
+                "replica.error",
+                severity="error",
+                replica=self.replica_id,
+                app=self.app_id,
+                deployment=self.deployment_name,
+                phase="start",
+                error=str(e)[:500],
+            )
+            flight.dump("replica_error", replica=self.replica_id)
             raise
 
     async def _run_test(self) -> None:
@@ -170,6 +224,16 @@ class Replica:
             self.state = ReplicaState.UNHEALTHY
             self.last_error = self._test_error
             self._log(f"test_deployment failed: {e}")
+            flight.record(
+                "replica.error",
+                severity="error",
+                replica=self.replica_id,
+                app=self.app_id,
+                deployment=self.deployment_name,
+                phase="test_deployment",
+                error=str(e)[:500],
+            )
+            flight.dump("replica_error", replica=self.replica_id)
 
     async def check_health(self) -> ReplicaState:
         """init done -> test passed -> user check_health."""
@@ -206,6 +270,13 @@ class Replica:
         ):
             self.state = ReplicaState.DRAINING
             self._log(f"draining ({self._ongoing} in-flight)")
+            flight.record(
+                "replica.drain",
+                replica=self.replica_id,
+                app=self.app_id,
+                deployment=self.deployment_name,
+                in_flight=self._ongoing,
+            )
         if self._ongoing == 0:
             return True
         timeout = self.drain_timeout_s if timeout_s is None else timeout_s
@@ -216,6 +287,15 @@ class Replica:
             self._log(
                 f"drain timed out after {timeout}s "
                 f"({self._ongoing} requests stranded)"
+            )
+            flight.record(
+                "replica.drain",
+                severity="warning",
+                replica=self.replica_id,
+                app=self.app_id,
+                deployment=self.deployment_name,
+                timed_out=True,
+                stranded=self._ongoing,
             )
             return False
 
@@ -282,6 +362,14 @@ class Replica:
             if self._requests_total is not None:
                 self._requests_total.inc()
             t_exec = time.monotonic()
+            # chip-seconds accumulate here, where app/deployment/method
+            # labels exist: engines called (directly or through the
+            # batcher/dispatch thread) add wall x mesh-width into the
+            # request-scoped accumulator. Batched flushes attribute the
+            # whole batch's device time to the submitter whose context
+            # the flush task inherited — totals stay exact, per-method
+            # attribution amortizes across co-batched requests.
+            acc, cs_token = tracing.start_chip_accounting()
             try:
                 with tracing.trace_span(
                     "replica.execute",
@@ -290,6 +378,15 @@ class Replica:
                 ):
                     return await _maybe_await(fn(*args, **kwargs))
             finally:
+                tracing.stop_chip_accounting(cs_token)
+                if acc.seconds > 0.0:
+                    self._chip_seconds += acc.seconds
+                    child = self._m_chip.get(method)
+                    if child is None:
+                        child = self._m_chip[method] = CHIP_SECONDS.labels(
+                            self.app_id, self.deployment_name, method
+                        )
+                    child.inc(acc.seconds)
                 if m_on and self._m_latency is not None:
                     self._m_latency.observe(time.monotonic() - t_exec)
                 self._ongoing -= 1
@@ -334,6 +431,10 @@ class Replica:
                 else 0
             ),
             "load": self.load,
+            # device-seconds this replica's requests consumed (engine
+            # wall x mesh width) — the per-replica slice of the
+            # chip_seconds_total{app,deployment,method} counter
+            "chip_seconds_total": round(self._chip_seconds, 6),
             # monotonic, not wall — an NTP step must not age a replica
             "uptime_seconds": time.monotonic() - self._started_mono,
             "last_error": self.last_error,

@@ -110,6 +110,15 @@ async def hit(
     _hits[point] = n = _hits[point] + 1
     if not (spec.nth <= n < spec.nth + spec.count):
         return
+    # a TRIGGERED fault is incident evidence: chaos tests assert the
+    # flight timeline shows injected failures where they were injected
+    # (guarded by ACTIVE at call sites — zero cost in clean processes)
+    from bioengine_tpu.utils import flight
+
+    flight.record(
+        "fault.hit", severity="warning",
+        point=point, action=spec.action, hit=n,
+    )
     if spec.action == "delay":
         await asyncio.sleep(spec.delay_s)
         return

@@ -29,10 +29,14 @@ or request ids (those belong on traces, utils/tracing.py).
 from __future__ import annotations
 
 import bisect
+import logging
 import math
 import threading
+import time
 import weakref
 from typing import Any, Callable, Iterable, Optional, Sequence
+
+_collector_logger = logging.getLogger("bioengine.metrics")
 
 # Prometheus-convention latency buckets (seconds). Explicit, not
 # exponential-by-config: the serve path spans ~1 ms (cache-hit CPU
@@ -288,8 +292,9 @@ class MetricsRegistry:
         for cname, fn in collectors:
             try:
                 out.extend(fn())
-            except Exception:  # noqa: BLE001 — one bad collector never
-                pass           # breaks the whole scrape
+            except Exception as e:  # noqa: BLE001 — one bad collector
+                # never breaks the whole scrape; it does leave a trace
+                _collector_logger.debug(f"collector '{cname}' failed: {e}")
         return out
 
     # ---- export -------------------------------------------------------------
@@ -465,3 +470,180 @@ class InstanceSet:
 
     def _collect(self) -> Iterable[Sample]:
         return self._fold(list(self._instances))
+
+
+# ---------------------------------------------------------------------------
+# Process self-metrics: event-loop lag, RSS, open fds, GC pauses
+# ---------------------------------------------------------------------------
+#
+# The serving plane measures requests; these measure the PROCESS the
+# requests run in — the numbers that explain a latency regression no
+# request-level metric can (a blocked event loop, a leak marching RSS
+# toward the OOM killer, fd exhaustion, GC pressure). All are
+# scrape-time reads except the loop-lag gauge, which a supervised
+# ticker samples (a scrape can't observe the loop from inside a
+# blocked loop), and GC pauses, which gc callbacks accumulate.
+
+_proc_lock = threading.Lock()
+_loop_lag = {"last_s": 0.0, "max_s": 0.0, "samples": 0}
+# gc stats are LOCK-FREE by design: gc.callbacks run synchronously on
+# whatever thread's allocation crossed the collection threshold — if
+# that thread already holds a lock the callback needs (e.g. a scrape
+# holding _proc_lock allocating its snapshot), a locking callback
+# self-deadlocks and wedges the process. Plain GIL-protected updates
+# suffice; readers may see a value one collection stale. Generations
+# are pre-seeded so the dict never changes size under an iterating
+# reader.
+_gc_stats: dict[str, Any] = {
+    "pause_seconds": 0.0,
+    "collections": {0: 0, 1: 0, 2: 0},   # generation -> count
+    "collected": 0,
+    "start_mono": None,
+    "installed": False,
+}
+_loop_monitor_running = False
+
+
+def _gc_callback(phase: str, info: dict) -> None:
+    # module-global time, no lazy import: this callback outlives the
+    # import machinery (gc runs during interpreter shutdown). NO locks
+    # here — see the note on _gc_stats.
+    if phase == "start":
+        _gc_stats["start_mono"] = time.monotonic()
+        return
+    start = _gc_stats["start_mono"]
+    if start is not None:
+        _gc_stats["pause_seconds"] += time.monotonic() - start
+        _gc_stats["start_mono"] = None
+    gen = info.get("generation", 0)
+    counts = _gc_stats["collections"]
+    counts[gen] = counts.get(gen, 0) + 1
+    _gc_stats["collected"] += info.get("collected", 0)
+
+
+def _read_rss_bytes() -> Optional[float]:
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        import os as _os
+
+        return float(pages * _os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, ValueError, IndexError):
+        try:
+            import resource
+
+            # ru_maxrss is PEAK rss in KiB on linux — a coarser truth
+            # than live rss, still the right alarm signal
+            return float(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+            )
+        except Exception:  # noqa: BLE001 — no rss source on this platform
+            return None
+
+
+def _count_open_fds() -> Optional[float]:
+    try:
+        import os as _os
+
+        return float(len(_os.listdir("/proc/self/fd")))
+    except OSError:
+        return None
+
+
+def _collect_process() -> Iterable[Sample]:
+    out: list[Sample] = []
+    rss = _read_rss_bytes()
+    if rss is not None:
+        out.append(
+            Sample(
+                "process_rss_bytes", rss,
+                help="resident set size of this process",
+            )
+        )
+    fds = _count_open_fds()
+    if fds is not None:
+        out.append(
+            Sample(
+                "process_open_fds", fds,
+                help="open file descriptors (sockets, shm maps, logs)",
+            )
+        )
+    with _proc_lock:
+        lag_last, lag_max, lag_n = (
+            _loop_lag["last_s"], _loop_lag["max_s"], _loop_lag["samples"],
+        )
+    # gc stats read OUTSIDE the lock (the gc callback is lock-free and
+    # the collections dict never changes size — generations pre-seeded)
+    gc_pause = _gc_stats["pause_seconds"]
+    gc_colls = dict(_gc_stats["collections"])
+    gc_collected = _gc_stats["collected"]
+    if lag_n:
+        out.append(
+            Sample(
+                "event_loop_lag_seconds", round(lag_last, 6),
+                help="latest sampled event-loop scheduling lag",
+            )
+        )
+        out.append(
+            Sample(
+                "event_loop_lag_max_seconds", round(lag_max, 6),
+                help="worst event-loop lag since process start",
+            )
+        )
+    out.append(
+        Sample(
+            "gc_pause_seconds_total", round(gc_pause, 6), kind="counter",
+            help="cumulative stop-the-world gc pause time",
+        )
+    )
+    for gen, n in sorted(gc_colls.items()):
+        out.append(
+            Sample(
+                "gc_collections_total", n, {"generation": str(gen)},
+                kind="counter", help="gc runs by generation",
+            )
+        )
+    out.append(
+        Sample(
+            "gc_collected_objects_total", gc_collected, kind="counter",
+            help="objects reclaimed by the cyclic gc",
+        )
+    )
+    return out
+
+
+def install_process_metrics() -> None:
+    """Register the process collector + gc callbacks (idempotent —
+    worker and worker_host both call this at startup; an in-process
+    test harness hosting several of them installs once)."""
+    register_collector("process", _collect_process)
+    if not _gc_stats["installed"]:
+        import gc
+
+        gc.callbacks.append(_gc_callback)
+        _gc_stats["installed"] = True
+
+
+async def monitor_event_loop(interval_s: float = 0.5) -> None:
+    """Supervised ticker: sleep ``interval_s``, measure the overshoot —
+    that overshoot IS the event-loop scheduling lag every coroutine in
+    this process experiences. Runs forever; spawn it supervised and
+    cancel at shutdown. A second ticker in the same process returns
+    immediately (one sampler is the truth)."""
+    import asyncio
+
+    global _loop_monitor_running
+    if _loop_monitor_running:
+        return
+    _loop_monitor_running = True
+    try:
+        while True:
+            t0 = time.monotonic()
+            await asyncio.sleep(interval_s)
+            lag = max(0.0, (time.monotonic() - t0) - interval_s)
+            with _proc_lock:
+                _loop_lag["last_s"] = lag
+                _loop_lag["max_s"] = max(_loop_lag["max_s"], lag)
+                _loop_lag["samples"] += 1
+    finally:
+        _loop_monitor_running = False

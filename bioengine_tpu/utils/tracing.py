@@ -57,6 +57,9 @@ _current: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
 _trace: contextvars.ContextVar[Optional["TraceContext"]] = (
     contextvars.ContextVar("bioengine_trace", default=None)
 )
+_chip: contextvars.ContextVar[Optional["ChipSecondsAccumulator"]] = (
+    contextvars.ContextVar("bioengine_chip_seconds", default=None)
+)
 
 
 def _new_id() -> str:
@@ -183,24 +186,71 @@ def current_span_id() -> Optional[str]:
 
 
 def carry(ctx: Optional[TraceContext], fn):
-    """Wrap ``fn`` so it runs with ``ctx`` active — the bridge into
-    worker threads (engine dispatch thread, pipeline stages) where
-    asyncio's automatic contextvar propagation does not reach."""
-    if ctx is None or not ctx.sampled:
+    """Wrap ``fn`` so it runs with ``ctx`` (and the chip-seconds
+    accumulator, when one is active) installed — the bridge into worker
+    threads (engine dispatch thread, pipeline stages) where asyncio's
+    automatic contextvar propagation does not reach. Chip accounting
+    crosses even for unsampled requests: cost is accounting, not
+    sampled telemetry."""
+    acc = _chip.get()
+    sampled = ctx is not None and ctx.sampled
+    if not sampled and acc is None:
         return fn
 
     parent = _current.get()
 
     def wrapped(*args, **kwargs):
-        token = _trace.set(ctx)
-        token2 = _current.set(parent)
+        tokens = []
+        if sampled:
+            tokens.append((_trace, _trace.set(ctx)))
+            tokens.append((_current, _current.set(parent)))
+        if acc is not None:
+            tokens.append((_chip, _chip.set(acc)))
         try:
             return fn(*args, **kwargs)
         finally:
-            _current.reset(token2)
-            _trace.reset(token)
+            for var, token in reversed(tokens):
+                var.reset(token)
 
     return wrapped
+
+
+# ---------------------------------------------------------------------------
+# chip-seconds accounting (request-scoped device-cost accumulator)
+# ---------------------------------------------------------------------------
+
+
+class ChipSecondsAccumulator:
+    """Mutable per-request device-cost sink. The replica installs one
+    around instance execution; every engine ``predict`` underneath
+    (including on the dispatch thread, via :func:`carry`) adds its
+    wall seconds x mesh width. Unlike spans this is NOT sampled —
+    chip-seconds are the billing/scheduling signal and must be exact."""
+
+    __slots__ = ("seconds",)
+
+    def __init__(self):
+        self.seconds = 0.0
+
+
+def start_chip_accounting() -> tuple[ChipSecondsAccumulator, Any]:
+    """Install a fresh accumulator; returns ``(accumulator, token)``
+    for :func:`stop_chip_accounting`."""
+    acc = ChipSecondsAccumulator()
+    return acc, _chip.set(acc)
+
+
+def stop_chip_accounting(token) -> None:
+    _chip.reset(token)
+
+
+def add_chip_seconds(seconds: float) -> None:
+    """Engines call this once per prediction: one contextvar read when
+    no request accounting is active (engine used outside the serve
+    path), one float add when it is."""
+    acc = _chip.get()
+    if acc is not None and seconds > 0.0:
+        acc.seconds += seconds
 
 
 # ---------------------------------------------------------------------------
@@ -325,9 +375,12 @@ def get_spans(
     max_spans: int = 200,
     include_open: bool = False,
     trace_id: Optional[str] = None,
+    since: Optional[float] = None,
 ) -> list[dict]:
-    """Most recent spans in OPEN order; filtered by name / trace_id.
-    Open (in-flight) spans are excluded unless ``include_open``."""
+    """Most recent spans in OPEN order; filtered by name / trace_id /
+    wall-clock ``since`` (``started_at >= since`` — the pagination
+    cursor for repeated ``get_traces`` pulls). Open (in-flight) spans
+    are excluded unless ``include_open``."""
     with _lock:
         items = list(_spans)
     if not include_open:
@@ -336,7 +389,23 @@ def get_spans(
         items = [s for s in items if s["name"] == name]
     if trace_id is not None:
         items = [s for s in items if s.get("trace_id") == trace_id]
+    if since is not None:
+        items = [s for s in items if s.get("started_at", 0.0) >= since]
     return items[-max_spans:]
+
+
+def trace_attr_sum(trace_id: str, name: str, attr: str) -> float:
+    """Sum a numeric span attr across one trace in a single pass under
+    the lock — no ring copy, no intermediate lists. The per-sampled-
+    request path (trace-root chip_seconds) calls this; at 100% sampling
+    a copying scan of the 4096-span ring per request would be the
+    dominant tracing cost."""
+    total = 0.0
+    with _lock:
+        for s in _spans:
+            if s.get("trace_id") == trace_id and s["name"] == name:
+                total += s["attrs"].get(attr, 0.0) or 0.0
+    return total
 
 
 def build_trace_tree(trace_id: str) -> dict:

@@ -281,8 +281,8 @@ class CodeExecutor:
         if self.on_submit:
             try:
                 self.on_submit()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — a hook never fails a submit
+                self.logger.debug(f"on_submit hook failed (tolerated): {e}")
 
         if num_chips <= 0:
             env = {**os.environ, **(options.get("env_vars") or {})}

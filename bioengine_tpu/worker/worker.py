@@ -191,6 +191,17 @@ class BioEngineWorker:
                 self.startup_applications
             )
 
+        # process self-metrics: rss / fds / gc collectors + the
+        # event-loop lag ticker (a scrape can't measure a blocked loop
+        # from inside it — the supervised ticker can)
+        from bioengine_tpu.utils import metrics as _metrics
+
+        _metrics.install_process_metrics()
+        self._loop_lag_task = spawn_supervised(
+            _metrics.monitor_event_loop(),
+            name="event-loop-lag-monitor",
+            logger=self.logger,
+        )
         self._monitor_task = asyncio.create_task(self._monitor_loop())
         self._geo_task = asyncio.create_task(self._fetch_geo_location())
         self.is_ready = True
@@ -218,6 +229,9 @@ class BioEngineWorker:
             if self._geo_task:
                 self._geo_task.cancel()
                 self._geo_task = None
+            if getattr(self, "_loop_lag_task", None):
+                self._loop_lag_task.cancel()
+                self._loop_lag_task = None
             if self.apps_manager:
                 try:
                     admin_ctx = create_context(
@@ -289,8 +303,8 @@ class BioEngineWorker:
         try:
             if self.cluster.is_ready:
                 self.cluster.monitor_cluster()
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — a nudge must never fail a submit
+            self.logger.debug(f"scaling nudge failed (tolerated): {e}")
 
     # ---- service surface (ref worker.py:614-664) ----------------------------
 
@@ -306,9 +320,12 @@ class BioEngineWorker:
             "stop_worker": self._stop_worker_service,
             "start_profiling": self.start_profiling,
             "stop_profiling": self.stop_profiling,
+            "profile_replica": self.profile_replica,
             "memory_profile": self.memory_profile,
             "get_traces": self.get_traces,
             "get_metrics": self.get_metrics,
+            "get_flight_record": self.get_flight_record,
+            "debug_bundle": self.debug_bundle,
             **self.code_executor.service_methods(),
         }
         assert self.apps_manager is not None
@@ -406,32 +423,96 @@ class BioEngineWorker:
         process executes (serving replicas included — they run
         in-process). Inspect with tensorboard/xprof. Admin-only."""
         check_permissions(context, self.admin_users, "start_profiling")
-        import jax
+        from bioengine_tpu.utils import profiling
 
-        if getattr(self, "_profile_dir", None):
-            raise RuntimeError(
-                f"profiling already active -> {self._profile_dir}"
-            )
-        trace_dir = trace_dir or str(
-            self.workspace_dir / "profiles" / time.strftime("%Y%m%d-%H%M%S")
+        self._profile_dir = profiling.start_trace(
+            self.workspace_dir, trace_dir, getattr(self, "_profile_dir", None)
         )
-        Path(trace_dir).mkdir(parents=True, exist_ok=True)
-        jax.profiler.start_trace(trace_dir)
-        self._profile_dir = trace_dir
-        self.logger.info(f"profiling started -> {trace_dir}")
-        return {"trace_dir": trace_dir, "profiling": True}
+        self.logger.info(f"profiling started -> {self._profile_dir}")
+        return {"trace_dir": self._profile_dir, "profiling": True}
 
     def stop_profiling(self, context: Optional[dict] = None) -> dict:
         check_permissions(context, self.admin_users, "stop_profiling")
-        import jax
+        from bioengine_tpu.utils import profiling
 
-        trace_dir = getattr(self, "_profile_dir", None)
-        if not trace_dir:
-            raise RuntimeError("profiling is not active")
-        jax.profiler.stop_trace()
+        trace_dir = profiling.stop_trace(getattr(self, "_profile_dir", None))
         self._profile_dir = None
         self.logger.info(f"profiling stopped -> {trace_dir}")
         return {"trace_dir": trace_dir, "profiling": False}
+
+    async def profile_replica(
+        self,
+        app_id: str,
+        deployment: Optional[str] = None,
+        replica_id: Optional[str] = None,
+        action: str = "start",
+        trace_dir: Optional[str] = None,
+        context: Optional[dict] = None,
+    ) -> dict:
+        """Profile ONE replica of a live deployment: resolves the
+        replica (by id, or the first routable one) and routes
+        ``start``/``stop``/``memory`` to the process that actually
+        runs it — this worker for local placement, the owning worker
+        host over RPC for remote placement. jax.profiler is
+        process-global, so on a multi-replica host the trace covers
+        that host process; the point is picking WHICH host of a live
+        deployment pays the profiling overhead. Admin-only."""
+        check_permissions(context, self.admin_users, "profile_replica")
+        if action not in ("start", "stop", "memory"):
+            raise ValueError(
+                f"action must be start|stop|memory, got '{action}'"
+            )
+        assert self.controller is not None
+        app = self.controller.apps.get(app_id)
+        if app is None:
+            raise KeyError(f"app '{app_id}' not deployed")
+        if deployment is None:
+            deployment = next(iter(app.specs))
+        replicas = app.replicas.get(deployment, [])
+        if replica_id is not None:
+            matches = [r for r in replicas if r.replica_id == replica_id]
+            if not matches:
+                raise KeyError(
+                    f"no replica '{replica_id}' in {app_id}/{deployment}"
+                )
+            replica = matches[0]
+        else:
+            from bioengine_tpu.serving.replica import ROUTABLE_STATES
+
+            routable = [r for r in replicas if r.state in ROUTABLE_STATES]
+            if not routable:
+                raise RuntimeError(
+                    f"no routable replica in {app_id}/{deployment}"
+                )
+            replica = routable[0]
+        target = {
+            "replica_id": replica.replica_id,
+            "app_id": app_id,
+            "deployment": deployment,
+        }
+        if getattr(replica, "is_remote", False):
+            verb = {
+                "start": "start_profiling",
+                "stop": "stop_profiling",
+                "memory": "memory_profile",
+            }[action]
+            kwargs = (
+                {"trace_dir": trace_dir}
+                if action == "start" and trace_dir
+                else {}
+            )
+            result = await self.controller._call_host(
+                replica.host_service_id, verb, **kwargs
+            )
+            return {**target, "host_id": replica.host_id, **result}
+        # local replica: it runs in THIS process
+        if action == "start":
+            result = self.start_profiling(trace_dir=trace_dir, context=context)
+        elif action == "stop":
+            result = self.stop_profiling(context=context)
+        else:
+            result = self.memory_profile(context=context)
+        return {**target, "host_id": "local", **result}
 
     def get_traces(
         self,
@@ -439,21 +520,71 @@ class BioEngineWorker:
         max_spans: int = 200,
         trace_id: Optional[str] = None,
         include_open: bool = False,
+        limit: Optional[int] = None,
+        since: Optional[float] = None,
         context: Optional[dict] = None,
     ) -> Any:
         """Recent spans (control-plane events + sampled request
         traces), newest last. With ``trace_id`` returns that request's
         reconstructed cross-process span tree (remote spans arrive
         piggybacked on RPC results) with a per-stage latency rollup.
-        Admin-only."""
+        Paginate with ``limit`` (caps the returned spans; alias of
+        ``max_spans``) and ``since`` (wall-clock ``started_at`` cursor:
+        pass the newest span's ``started_at`` from the previous pull) —
+        repeated polling never re-ships the whole buffer. Admin-only."""
         check_permissions(context, self.admin_users, "get_traces")
         from bioengine_tpu.utils.tracing import build_trace_tree, get_spans
 
         if trace_id is not None:
             return build_trace_tree(trace_id)
         return get_spans(
-            name=name, max_spans=max_spans, include_open=include_open
+            name=name,
+            max_spans=limit if limit is not None else max_spans,
+            include_open=include_open,
+            since=since,
         )
+
+    def get_flight_record(
+        self,
+        limit: Optional[int] = 500,
+        since: Optional[float] = None,
+        context: Optional[dict] = None,
+    ) -> dict:
+        """This process's flight-recorder ring: the structured event
+        timeline (replica transitions, breaker trips, drains,
+        reconnects, compiles, fault hits, slow requests) plus dump
+        metadata. ``limit``/``since`` paginate like ``get_traces``.
+        Admin-only."""
+        check_permissions(context, self.admin_users, "get_flight_record")
+        from bioengine_tpu.utils import flight
+
+        return flight.get_record(limit=limit, since=since)
+
+    async def debug_bundle(
+        self,
+        event_limit: int = 2000,
+        max_spans: int = 1000,
+        context: Optional[dict] = None,
+    ) -> dict:
+        """One incident artifact (the ``bioengine debug bundle`` CLI):
+        flight records + recent traces + metrics snapshot + mesh/lease
+        state from this worker AND every reachable worker host, with
+        all flight events time-merged into a single timeline.
+        Admin-only."""
+        check_permissions(context, self.admin_users, "debug_bundle")
+        assert self.controller is not None
+        bundle = await self.controller.debug_bundle(
+            event_limit=event_limit, max_spans=max_spans
+        )
+        bundle["worker"] = {
+            "rpc_url": self.server.url,
+            "service_id": self._service_id,
+            "ready": self.is_ready,
+            "uptime_seconds": (
+                time.monotonic() - self._start_mono if self._start_mono else 0.0
+            ),
+        }
+        return bundle
 
     def get_metrics(
         self,
@@ -478,22 +609,9 @@ class BioEngineWorker:
         reference scraping GPU memory off the Ray dashboard (ref
         cluster/proxy_actor.py:230-287)."""
         check_permissions(context, self.admin_users, "memory_profile")
-        import base64 as b64
+        from bioengine_tpu.utils import profiling
 
-        import jax
-
-        prof = jax.profiler.device_memory_profile()
-        return {
-            "pprof_b64": b64.b64encode(prof).decode(),
-            "devices": [
-                {
-                    "id": d.id,
-                    "kind": d.device_kind,
-                    "memory_stats": d.memory_stats() or {},
-                }
-                for d in jax.local_devices()
-            ],
-        }
+        return profiling.device_memory_snapshot()
 
     # ---- status / logs (ref worker.py:1034-1159) ----------------------------
 

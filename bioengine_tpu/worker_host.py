@@ -48,6 +48,7 @@ from typing import Any, Optional
 
 from bioengine_tpu.rpc.client import ServerConnection, connect_to_server
 from bioengine_tpu.testing import faults
+from bioengine_tpu.utils import flight
 from bioengine_tpu.utils.logger import create_logger
 
 
@@ -137,6 +138,10 @@ class WorkerHost:
                 "config": {"require_context": False, "visibility": "protected"},
                 "describe": self.describe,
                 "get_metrics": self.get_metrics,
+                "get_flight_record": self.get_flight_record,
+                "start_profiling": self.start_profiling,
+                "stop_profiling": self.stop_profiling,
+                "memory_profile": self.memory_profile,
                 "start_replica": self.start_replica,
                 "replica_call": self.replica_call,
                 "replica_health": self.replica_health,
@@ -147,6 +152,17 @@ class WorkerHost:
             }
         )
         self.service_id = result["id"]
+        # process self-metrics for THIS host process (its /metrics ride
+        # the controller's get_metrics pull + incident bundles)
+        from bioengine_tpu.utils import metrics as _metrics
+        from bioengine_tpu.utils.tasks import spawn_supervised
+
+        _metrics.install_process_metrics()
+        self._loop_lag_task = spawn_supervised(
+            _metrics.monitor_event_loop(),
+            name="event-loop-lag-monitor",
+            logger=self.logger,
+        )
         joined = await self._register_host()
         self.logger.info(
             f"joined cluster as '{self.host_id}' "
@@ -200,6 +216,12 @@ class WorkerHost:
             f"(kept {len(self.replicas)} warm replicas, "
             f"dropped {len(dropped)})"
         )
+        flight.record(
+            "host.rejoin",
+            host=self.host_id,
+            kept=len(self.replicas),
+            dropped=len(dropped),
+        )
 
     async def serve_forever(self) -> None:
         """Block until shutdown. A dropped control-plane connection
@@ -232,6 +254,9 @@ class WorkerHost:
                 )
 
     async def stop(self) -> None:
+        if getattr(self, "_loop_lag_task", None):
+            self._loop_lag_task.cancel()
+            self._loop_lag_task = None
         for replica_id in list(self.replicas):
             await self.stop_replica(replica_id)
         if self.connection is not None:
@@ -239,8 +264,8 @@ class WorkerHost:
                 await self.connection.call(
                     "serve-router", "deregister_host", self.host_id
                 )
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — controller may be gone
+                self.logger.debug(f"deregister_host failed (tolerated): {e}")
             await self.connection.disconnect()
             self.connection = None
         if self._owns_workspace:
@@ -409,6 +434,60 @@ class WorkerHost:
         if prometheus:
             return metrics.render_prometheus()
         return metrics.collect()
+
+    def get_flight_record(
+        self, limit: Optional[int] = 500, since: Optional[float] = None
+    ) -> dict:
+        """This host process's flight-recorder events + dump metadata,
+        stamped with its host_id so the controller's time-merged
+        incident bundle can attribute every event. Protected service —
+        admin callers only."""
+        record = flight.get_record(limit=limit, since=since)
+        record["host_id"] = self.host_id
+        return record
+
+    # ---- on-demand device profiling (routed here by the controller so
+    # an operator can profile ONE replica of a live deployment; the
+    # PR 5 RTLD_DEEPBIND codec fix makes jax.profiler safe to enable
+    # in a serving process) ------------------------------------------------
+
+    def start_profiling(self, trace_dir: Optional[str] = None) -> dict:
+        """Start a jax.profiler trace covering everything this host
+        process executes (its replicas included). One trace at a time
+        per process — jax.profiler is process-global."""
+        from bioengine_tpu.utils import profiling
+
+        self._profile_dir = profiling.start_trace(
+            self.workspace_dir, trace_dir, getattr(self, "_profile_dir", None)
+        )
+        self.logger.info(f"profiling started -> {self._profile_dir}")
+        return {
+            "host_id": self.host_id,
+            "trace_dir": self._profile_dir,
+            "profiling": True,
+        }
+
+    def stop_profiling(self) -> dict:
+        from bioengine_tpu.utils import profiling
+
+        trace_dir = profiling.stop_trace(getattr(self, "_profile_dir", None))
+        self._profile_dir = None
+        self.logger.info(f"profiling stopped -> {trace_dir}")
+        return {
+            "host_id": self.host_id,
+            "trace_dir": trace_dir,
+            "profiling": False,
+        }
+
+    def memory_profile(self) -> dict:
+        """Device-memory snapshot (pprof bytes + per-device stats) —
+        HBM residency of the replicas this host serves."""
+        from bioengine_tpu.utils import profiling
+
+        return {
+            "host_id": self.host_id,
+            **profiling.device_memory_snapshot(),
+        }
 
     def describe(self) -> dict:
         d = {

@@ -1,0 +1,88 @@
+#!/usr/bin/env bash
+# Observability gate: tracing/metrics plane tests, flight-recorder +
+# incident-bundle tests, process self-metrics — plus a dryrun
+# incident-bundle round-trip against the in-process multi-host harness
+# (controller + 2 worker hosts over real websockets, a fault-injected
+# failure, then `debug_bundle` must return one time-merged artifact).
+set -euo pipefail
+
+cd "$(dirname "$0")/../.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== observability test suites =="
+timeout -k 10 600 python -m pytest \
+    tests/test_observability.py tests/test_metrics.py tests/test_flight.py \
+    -q -rA -p no:cacheprovider
+
+echo "== dryrun incident-bundle round-trip =="
+timeout -k 10 180 python - <<'EOF'
+import asyncio, json
+
+from bioengine_tpu.cluster.state import ClusterState
+from bioengine_tpu.cluster.topology import TpuTopology
+from bioengine_tpu.rpc.server import RpcServer
+from bioengine_tpu.serving import DeploymentSpec, RequestOptions, ServeController
+from bioengine_tpu.testing import faults
+from bioengine_tpu.utils import flight
+from bioengine_tpu.worker_host import WorkerHost
+
+
+class Echo:
+    async def ping(self):
+        return "pong"
+
+
+async def main():
+    server = RpcServer(host="127.0.0.1", admin_users=["admin"])
+    await server.start()
+    token = server.issue_token("admin", is_admin=True)
+    controller = ServeController(
+        ClusterState(TpuTopology(chips=(), n_hosts=1, platform="cpu")),
+        health_check_period=3600,
+    )
+    controller.attach_rpc(server, admin_users=["admin"])
+    hosts = [
+        WorkerHost(server_url=server.url, token=token, host_id=f"h{i}")
+        for i in (1, 2)
+    ]
+    for h in hosts:
+        await h.start()
+    await controller.deploy(
+        "bundle-app", [DeploymentSpec(name="entry", instance_factory=Echo)]
+    )
+    handle = controller.get_handle("bundle-app")
+    assert await handle.call("ping") == "pong"
+    # one injected transport failure -> failover evidence in the ring
+    faults.configure("rpc.client.send", "raise", nth=1, count=1)
+    try:
+        await hosts[0].connection.call("serve-router", "deregister_host", "nope")
+    except Exception:
+        faults.clear()
+    faults.clear()
+
+    bundle = await controller.debug_bundle()
+    for key in ("events", "traces", "metrics", "cluster", "apps", "hosts"):
+        assert key in bundle, key
+    assert len(bundle["hosts"]) == 2, bundle["hosts"]
+    assert all(h["reachable"] for h in bundle["hosts"].values())
+    types = {e["type"] for e in bundle["events"]}
+    assert "host.join" in types, types
+    assert "fault.hit" in types, types
+    ts = [e["ts"] for e in bundle["events"]]
+    assert ts == sorted(ts), "bundle events are not time-ordered"
+    json.dumps(bundle, default=str)  # the artifact must serialize
+    print(
+        f"bundle OK: {len(bundle['events'])} events, "
+        f"{len(bundle['traces'])} spans, {len(bundle['hosts'])} hosts"
+    )
+    for h in hosts:
+        await h.stop()
+    await controller.stop()
+    await server.stop()
+
+
+asyncio.run(main())
+EOF
+
+echo "observability gate OK"

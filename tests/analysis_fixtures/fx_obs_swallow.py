@@ -1,0 +1,86 @@
+"""Seeded BE-OBS-002 violations: broad exception handlers whose whole
+body is ``pass`` — the failure leaves no log line, no flight event, no
+re-raise.
+
+Negative cases: narrow types, handlers that log / re-raise / return a
+fallback, and an ellipsis-free body with real work.
+"""
+
+import logging
+
+logger = logging.getLogger("fixture")
+
+
+def swallows_exception_silently():
+    try:
+        do_work()
+    except Exception:  # <- BE-OBS-002
+        pass
+
+
+def swallows_with_bare_except():
+    try:
+        do_work()
+    except:  # noqa: E722  # <- BE-OBS-002
+        pass
+
+
+def swallows_base_exception_with_ellipsis():
+    try:
+        do_work()
+    except BaseException:  # <- BE-OBS-002
+        ...
+
+
+def swallows_in_broad_tuple():
+    try:
+        do_work()
+    except (ValueError, Exception):  # <- BE-OBS-002
+        pass
+
+
+# ---- negative cases: none of these may fire -------------------------------
+
+
+def ignores_a_narrow_expected_condition():
+    try:
+        do_work()
+    except OSError:
+        pass  # a named, expected condition — a decision, not a swallow
+
+
+def ignores_a_narrow_tuple():
+    try:
+        do_work()
+    except (KeyError, StopIteration):
+        pass
+
+
+def logs_before_moving_on():
+    try:
+        do_work()
+    except Exception as e:  # noqa: BLE001
+        logger.debug(f"tolerated: {e}")
+
+
+def reraises_after_cleanup():
+    try:
+        do_work()
+    except Exception:
+        cleanup()
+        raise
+
+
+def falls_back_to_default():
+    try:
+        return do_work()
+    except Exception:
+        return None
+
+
+def cleanup():
+    pass
+
+
+def do_work():
+    pass

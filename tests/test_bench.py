@@ -156,10 +156,11 @@ def test_rpc_transport_stage_schema():
 
 
 def test_observability_overhead_stage_schema():
-    """Pin the observability_overhead artifact schema: three interleaved
-    legs (disabled / unsampled / sampled) over the same live serve path,
-    per-leg p50 and the relative + absolute unsampled overhead. The <2%
-    acceptance number comes from the full-size driver run — a loaded CI
+    """Pin the observability_overhead artifact schema: four interleaved
+    legs (disabled / unsampled / flight / sampled) over the same live
+    serve path, per-leg p50, the relative + absolute overheads, and the
+    flight-recorder-vs-unsampled delta. The <2% (and flight <1%)
+    acceptance numbers come from the full-size driver run — a loaded CI
     core would flake a hard threshold here, so the schema and sanity
     ordering are the contract."""
     proc, lines = _run(
@@ -179,12 +180,15 @@ def test_observability_overhead_stage_schema():
         "legs",
         "overhead_unsampled_pct",
         "overhead_unsampled_abs_us",
+        "overhead_flight_pct",
+        "overhead_flight_abs_us",
+        "overhead_flight_vs_unsampled_pct",
         "overhead_sampled_pct",
         "overhead_sampled_abs_us",
     ):
         assert key in st, key
     assert st["requests_per_leg"] == 50
-    for leg in ("disabled", "unsampled", "sampled"):
+    for leg in ("disabled", "unsampled", "flight", "sampled"):
         assert st["legs"][leg]["p50_us"] > 0, leg
     # full span recording can't be cheaper than the unsampled path's
     # contextvar reads (sanity on the leg wiring, not a perf threshold)

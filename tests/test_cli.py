@@ -263,7 +263,11 @@ class TestStandaloneUploader:
                     str(REPO_ROOT / "scripts" / "upload_app.py"),
                     str(REPO_ROOT / "apps" / "demo-app"),
                     "--server-url", server.http_url,
-                    "--token", token,
+                    # --token=<v>, not two argv entries: token_urlsafe
+                    # output can start with '-' (~1.6% of runs), which
+                    # argparse then rejects as an option — a latent
+                    # whole-suite flake
+                    f"--token={token}",
                 ],
                 capture_output=True, text=True, timeout=60,
             )

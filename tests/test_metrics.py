@@ -222,3 +222,62 @@ class TestProcessRegistry:
         monkeypatch.delenv("BIOENGINE_METRICS")
         metrics.reset_env_cache()
         assert metrics.metrics_enabled() is True
+
+
+class TestProcessSelfMetrics:
+    """Satellite (PR 7): rss / open-fd / gc / event-loop-lag samples."""
+
+    def _by_name(self, snap, name):
+        return snap.get(name, {}).get("series", [])
+
+    def test_rss_fds_and_gc_samples(self):
+        import gc
+
+        metrics.install_process_metrics()
+        metrics.install_process_metrics()  # idempotent
+        gc.collect()  # guarantee at least one recorded collection
+        snap = metrics.collect()
+        (rss,) = self._by_name(snap, "process_rss_bytes")
+        assert rss["value"] > 10 * 1024 * 1024  # a jax process is >10MB
+        (fds,) = self._by_name(snap, "process_open_fds")
+        assert fds["value"] > 0
+        pauses = self._by_name(snap, "gc_pause_seconds_total")
+        assert pauses and pauses[0]["value"] >= 0.0
+        colls = self._by_name(snap, "gc_collections_total")
+        assert colls, "no gc collections recorded after gc.collect()"
+        assert any(s["labels"].get("generation") == "2" for s in colls)
+        # rendered form is still valid exposition text
+        text = metrics.render_prometheus()
+        assert "bioengine_process_rss_bytes" in text
+
+    @pytest.mark.anyio
+    async def test_event_loop_lag_ticker_updates_gauge(self):
+        import asyncio
+
+        metrics.install_process_metrics()
+        task = asyncio.get_running_loop().create_task(
+            metrics.monitor_event_loop(interval_s=0.02)
+        )
+        try:
+            await asyncio.sleep(0.1)
+        finally:
+            task.cancel()
+        snap = metrics.collect()
+        lag = self._by_name(snap, "event_loop_lag_seconds")
+        assert lag, "loop-lag ticker produced no samples"
+        assert lag[0]["value"] >= 0.0
+        (lag_max,) = self._by_name(snap, "event_loop_lag_max_seconds")
+        assert lag_max["value"] >= lag[0]["value"] - 1e-9
+
+    @pytest.mark.anyio
+    async def test_second_ticker_is_a_noop(self):
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        t1 = loop.create_task(metrics.monitor_event_loop(interval_s=0.02))
+        await asyncio.sleep(0.05)
+        # the singleton guard returns immediately for a second sampler
+        await asyncio.wait_for(
+            metrics.monitor_event_loop(interval_s=0.02), timeout=1.0
+        )
+        t1.cancel()

@@ -166,7 +166,9 @@ def _spawn_host(server_url: str, token: str, host_id: str, tmp_path: Path):
             "-m",
             "bioengine_tpu.worker_host",
             "--server-url", server_url,
-            "--token", token,
+            # = form: a token_urlsafe value starting with '-' would be
+            # rejected as an option by argparse (latent flake)
+            f"--token={token}",
             "--host-id", host_id,
             "--platform", "cpu",
             "--workspace-dir", str(tmp_path / f"ws-{host_id}"),

@@ -568,6 +568,55 @@ class TestTracingDisabled:
         assert slow and "trace_id=-" in slow[-1].message
 
 
+class TestTraceBufferHardening:
+    async def test_span_ring_stays_bounded_under_sustained_sampled_load(
+        self,
+    ):
+        """Satellite: 100%-sampled load three times the ring size never
+        grows the buffer past MAX_SPANS — the ring is the memory
+        ceiling, not the request rate."""
+        ctx = tracing.maybe_start_trace(sample=True)
+        token = tracing.activate(ctx)
+        try:
+            for i in range(tracing.MAX_SPANS * 3):
+                with tracing.trace_span("load.span", i=i):
+                    pass
+        finally:
+            tracing.deactivate(token)
+        spans = tracing.get_spans(
+            max_spans=tracing.MAX_SPANS * 10, include_open=True
+        )
+        assert len(spans) <= tracing.MAX_SPANS
+        # newest survived, oldest rolled off
+        assert spans[-1]["attrs"]["i"] == tracing.MAX_SPANS * 3 - 1
+
+    async def test_get_spans_since_and_limit_paginate(self):
+        ctx = tracing.maybe_start_trace(sample=True)
+        token = tracing.activate(ctx)
+        try:
+            for i in range(10):
+                with tracing.trace_span("page.span", i=i):
+                    time.sleep(0.002)  # distinct wall started_at stamps
+        finally:
+            tracing.deactivate(token)
+        all_spans = tracing.get_spans(name="page.span", max_spans=100)
+        assert len(all_spans) == 10
+        # limit: newest N
+        assert [
+            s["attrs"]["i"] for s in tracing.get_spans(
+                name="page.span", max_spans=3
+            )
+        ] == [7, 8, 9]
+        # since: wall-clock cursor (inclusive)
+        cut = all_spans[6]["started_at"]
+        assert [
+            s["attrs"]["i"]
+            for s in tracing.get_spans(
+                name="page.span", max_spans=100, since=cut
+            )
+        ] == [6, 7, 8, 9]
+
+
 class TestSlowRequestLog:
     async def test_slow_request_logged_with_trace_id(
         self, obs_plane, monkeypatch, caplog

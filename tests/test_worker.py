@@ -403,6 +403,102 @@ async def test_worker_profiling_service(worker, tmp_path):
     assert mem["devices"]
 
 
+async def test_worker_profile_replica_routes_to_local(worker, tmp_path):
+    """PR 7: profile ONE replica of a live deployment — local placement
+    routes to this process's jax.profiler; the response names the
+    replica that was profiled."""
+    (app_id,) = worker.apps_manager.records
+    with pytest.raises(PermissionError):
+        await worker.profile_replica(app_id, context=ANON_CTX)
+    with pytest.raises(ValueError, match="start|stop|memory"):
+        await worker.profile_replica(
+            app_id, action="bogus", context=ADMIN_CTX
+        )
+    trace_dir = tmp_path / "replica-trace"
+    started = await worker.profile_replica(
+        app_id, trace_dir=str(trace_dir), context=ADMIN_CTX
+    )
+    assert started["profiling"] is True
+    assert started["host_id"] == "local"
+    assert started["app_id"] == app_id
+    assert started["replica_id"]
+    stopped = await worker.profile_replica(
+        app_id, action="stop", context=ADMIN_CTX
+    )
+    assert stopped["profiling"] is False
+    assert any(trace_dir.rglob("*")), "trace dir is empty"
+    mem = await worker.profile_replica(
+        app_id, action="memory", context=ADMIN_CTX
+    )
+    assert mem["devices"]
+    with pytest.raises(KeyError):
+        await worker.profile_replica(
+            app_id, replica_id="nope", context=ADMIN_CTX
+        )
+
+
+async def test_worker_flight_and_bundle_verbs(worker):
+    """PR 7: get_flight_record (paginated) + debug_bundle return the
+    incident surfaces over the worker service, admin-gated."""
+    from bioengine_tpu.utils import flight
+
+    with pytest.raises(PermissionError):
+        worker.get_flight_record(context=ANON_CTX)
+    flight.record("test.worker_verb", marker=1)
+    record = worker.get_flight_record(limit=500, context=ADMIN_CTX)
+    assert record["recorder"] == flight.recorder_id()
+    assert any(
+        e["type"] == "test.worker_verb" for e in record["events"]
+    )
+    # the startup sequence itself left evidence (replica placement)
+    assert any(
+        e["type"] == "replica.place" for e in record["events"]
+    )
+    # since-cursor pagination: nothing is older than now
+    import time as _time
+
+    assert (
+        worker.get_flight_record(since=_time.time() + 60, context=ADMIN_CTX)[
+            "events"
+        ]
+        == []
+    )
+
+    with pytest.raises(PermissionError):
+        await worker.debug_bundle(context=ANON_CTX)
+    bundle = await worker.debug_bundle(context=ADMIN_CTX)
+    for key in (
+        "events", "traces", "metrics", "cluster", "apps", "hosts", "worker",
+    ):
+        assert key in bundle, key
+    assert bundle["worker"]["ready"] is True
+    assert bundle["apps"], "deployed app missing from bundle"
+    (app_status,) = bundle["apps"].values()
+    assert "cost" in app_status
+
+
+async def test_worker_get_traces_pagination(worker):
+    """PR 7 satellite: get_traces limit/since — repeated pulls never
+    re-ship the whole buffer."""
+    from bioengine_tpu.utils import tracing
+
+    tracing.clear_spans()
+    for i in range(8):
+        with tracing.span("verb.span", i=i):
+            __import__("time").sleep(0.002)
+    spans = worker.get_traces(
+        name="verb.span", limit=3, context=ADMIN_CTX
+    )
+    assert [s["attrs"]["i"] for s in spans] == [5, 6, 7]
+    cursor = worker.get_traces(name="verb.span", max_spans=100, context=ADMIN_CTX)[
+        4
+    ]["started_at"]
+    newer = worker.get_traces(
+        name="verb.span", max_spans=100, since=cursor, context=ADMIN_CTX
+    )
+    assert [s["attrs"]["i"] for s in newer] == [4, 5, 6, 7]
+
+
 async def test_worker_dashboard_served(worker):
     """The built-in dashboard is served at /apps/_dashboard/ and its
     data endpoints (get_status via the bridge, /services) respond."""
