@@ -355,6 +355,16 @@ class RuntimeDeployment:
             self._devices = resolve_devices(list(lease))
         else:
             self._devices = None
+        # operator-tuned batching knobs from the deployment spec /
+        # manifest (deployment_config.<dep>.batching), injected by the
+        # replica lifecycle before async_init — they override the
+        # constructor defaults so batching is tunable without code
+        # changes
+        batch_cfg = getattr(self, "bioengine_batch_config", None) or {}
+        if batch_cfg.get("max_batch") is not None:
+            self.batch_max = int(batch_cfg["max_batch"])
+        if batch_cfg.get("max_wait_ms") is not None:
+            self.batch_wait_ms = float(batch_cfg["max_wait_ms"])
         if self.batch_max > 1:
             from bioengine_tpu.serving import ContinuousBatcher
 

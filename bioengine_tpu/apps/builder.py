@@ -29,6 +29,7 @@ from bioengine_tpu.apps.artifacts import LocalArtifactStore
 from bioengine_tpu.apps.manifest import AppManifest, load_manifest
 from bioengine_tpu.rpc.schema import is_schema_method
 from bioengine_tpu.serving.controller import DeploymentSpec
+from bioengine_tpu.serving.scheduler import SchedulingConfig
 from bioengine_tpu.utils.logger import create_logger
 
 # env var override mirroring the reference's local-artifact escape hatch
@@ -348,6 +349,38 @@ class AppBuilder:
             factory = self._make_factory(
                 cls, kwargs, handle_params, make_handle, app_dir
             )
+            # operator-facing batching knobs (manifest
+            # deployment_config.<dep>.batching) ride the spec so
+            # replicas — local or rebuilt from the shipped payload on a
+            # worker host — tune their ContinuousBatcher without code
+            # changes; scheduling opts the deployment into the global
+            # scheduler (cross-replica batching, admission control,
+            # predictive autoscaling)
+            batching = dict(cfg.get("batching") or {})
+            scheduling_cfg = cfg.get("scheduling")
+            try:
+                spec_max_batch = (
+                    int(batching["max_batch"])
+                    if "max_batch" in batching
+                    else None
+                )
+                spec_max_wait_ms = (
+                    float(batching["max_wait_ms"])
+                    if "max_wait_ms" in batching
+                    else None
+                )
+                scheduling = (
+                    SchedulingConfig.from_config(dict(scheduling_cfg))
+                    if scheduling_cfg
+                    else None
+                )
+            except (TypeError, ValueError) as e:
+                # every config mistake on this path fails TYPED with the
+                # deployment named — never a raw traceback
+                raise AppBuildError(
+                    f"invalid batching/scheduling config for deployment "
+                    f"'{ref.file_stem}': {e}"
+                ) from e
             specs.append(
                 DeploymentSpec(
                     name=ref.file_stem,
@@ -358,6 +391,9 @@ class AppBuilder:
                     chips_per_replica=int(cfg.get("chips", 0)),
                     max_ongoing_requests=int(cfg.get("max_ongoing_requests", 10)),
                     autoscale=bool(cfg.get("autoscale", True)),
+                    max_batch=spec_max_batch,
+                    max_wait_ms=spec_max_wait_ms,
+                    scheduling=scheduling,
                     remote_payload={
                         **base_payload,
                         "deployment": ref.file_stem,

@@ -23,6 +23,14 @@ ACCEPTED_TYPES = ("tpu-serve", "ray-serve")
 
 _DEPLOYMENT_RE = re.compile(r"^([A-Za-z_][\w\-/]*):([A-Za-z_]\w*)$")
 
+# operator-tunable per-deployment blocks with a fixed vocabulary —
+# validated here so a typo fails the manifest, not a live deploy.
+# ``batching`` feeds the replica's ContinuousBatcher (injected as
+# bioengine_batch_config); ``scheduling`` opts the deployment into the
+# controller's global scheduler (key set validated in depth by
+# serving.scheduler.SchedulingConfig.from_config at build time).
+_BATCHING_KEYS = {"max_batch", "max_wait_ms"}
+
 
 class ManifestError(ValueError):
     pass
@@ -78,6 +86,31 @@ def validate_manifest(data: dict[str, Any]) -> AppManifest:
         deployments.append(DeploymentRef(m.group(1), m.group(2)))
     if not deployments:
         raise ManifestError("manifest needs at least one deployment")
+    for dep_name, cfg in (data.get("deployment_config") or {}).items():
+        if not isinstance(cfg, dict):
+            raise ManifestError(
+                f"deployment_config.{dep_name} must be a mapping, got "
+                f"{type(cfg).__name__}"
+            )
+        batching = cfg.get("batching")
+        if batching is not None:
+            if not isinstance(batching, dict):
+                raise ManifestError(
+                    f"deployment_config.{dep_name}.batching must be a "
+                    f"mapping, got {type(batching).__name__}"
+                )
+            unknown = sorted(set(batching) - _BATCHING_KEYS)
+            if unknown:
+                raise ManifestError(
+                    f"deployment_config.{dep_name}.batching has unknown "
+                    f"keys {unknown} (accepted: {sorted(_BATCHING_KEYS)})"
+                )
+        scheduling = cfg.get("scheduling")
+        if scheduling is not None and not isinstance(scheduling, dict):
+            raise ManifestError(
+                f"deployment_config.{dep_name}.scheduling must be a "
+                f"mapping, got {type(scheduling).__name__}"
+            )
     return AppManifest(
         name=str(data["name"]),
         id=str(data["id"]),

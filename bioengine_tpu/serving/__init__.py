@@ -6,6 +6,7 @@ from bioengine_tpu.serving.controller import (
     ServeController,
 )
 from bioengine_tpu.serving.errors import (
+    AdmissionRejectedError,
     ApplicationError,
     DeadlineExceeded,
     NoHealthyReplicasError,
@@ -13,18 +14,29 @@ from bioengine_tpu.serving.errors import (
     RetryableTransportError,
 )
 from bioengine_tpu.serving.replica import Replica, ReplicaState
+from bioengine_tpu.serving.scheduler import (
+    DeploymentScheduler,
+    HeuristicCostModel,
+    LoadPredictor,
+    SchedulingConfig,
+)
 
 __all__ = [
+    "AdmissionRejectedError",
     "ApplicationError",
     "ContinuousBatcher",
     "DeadlineExceeded",
     "DeploymentHandle",
+    "DeploymentScheduler",
     "DeploymentSpec",
+    "HeuristicCostModel",
+    "LoadPredictor",
     "NoHealthyReplicasError",
     "Replica",
     "ReplicaState",
     "ReplicaUnavailableError",
     "RequestOptions",
     "RetryableTransportError",
+    "SchedulingConfig",
     "ServeController",
 ]

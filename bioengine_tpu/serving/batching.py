@@ -25,9 +25,17 @@ from typing import Any, Awaitable, Callable, Hashable, Optional
 from bioengine_tpu.utils import metrics, tracing
 from bioengine_tpu.utils.tasks import spawn_supervised
 
+# One source for the batching knob defaults: the in-replica batcher,
+# the operator-facing manifest knobs (deployment_config.<dep>.batching,
+# surfaced through DeploymentSpec and injected as
+# ``instance.bioengine_batch_config``), and the controller scheduler's
+# cross-replica groups all read these instead of re-hardcoding.
+DEFAULT_MAX_BATCH = 8
+DEFAULT_MAX_WAIT_MS = 10.0
+
 
 @dataclass
-class _PendingRequest:
+class PendingRequest:
     payload: Any
     future: asyncio.Future
     enqueued_at: float = field(default_factory=time.monotonic)
@@ -106,13 +114,13 @@ class ContinuousBatcher:
     def __init__(
         self,
         batch_fn: BatchFn,
-        max_batch: int = 8,
-        max_wait_ms: float = 10.0,
+        max_batch: int = DEFAULT_MAX_BATCH,
+        max_wait_ms: float = DEFAULT_MAX_WAIT_MS,
     ):
         self.batch_fn = batch_fn
         self.max_batch = max_batch
         self.max_wait_ms = max_wait_ms
-        self._groups: dict[Hashable, list[_PendingRequest]] = {}
+        self._groups: dict[Hashable, list[PendingRequest]] = {}
         self._flush_tasks: dict[Hashable, asyncio.Task] = {}
         self._inflight_flushes: set[asyncio.Task] = set()
         self._stats = {"requests": 0, "batches": 0, "batched_requests": 0}
@@ -130,7 +138,7 @@ class ContinuousBatcher:
         ctx = tracing.current_trace()
         sampled = ctx is not None and ctx.sampled
         group.append(
-            _PendingRequest(
+            PendingRequest(
                 payload,
                 fut,
                 trace_ctx=ctx if sampled else None,
@@ -194,7 +202,7 @@ class ContinuousBatcher:
         await self._run_batch(signature, group)
 
     async def _run_batch(
-        self, signature: Hashable, group: list[_PendingRequest]
+        self, signature: Hashable, group: list[PendingRequest]
     ) -> None:
         self._stats["batches"] += 1
         self._stats["batched_requests"] += len(group)
@@ -251,7 +259,7 @@ class ContinuousBatcher:
             s["batched_requests"] / s["batches"] if s["batches"] else 0.0
         )
         # how long requests sat in the queue before their group flushed
-        # (from _PendingRequest.enqueued_at) — the latency cost of
+        # (from PendingRequest.enqueued_at) — the latency cost of
         # batching, observable next to the throughput win
         waits = sorted(self._wait_samples)
         if waits:

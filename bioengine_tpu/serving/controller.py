@@ -28,14 +28,21 @@ from typing import Any, Callable, Optional
 from bioengine_tpu.cluster.state import ClusterState
 from bioengine_tpu.rpc.protocol import RemoteError
 from bioengine_tpu.serving.errors import (
+    AdmissionRejectedError,
     DeadlineExceeded,
     FailureKind,
     NoHealthyReplicasError,
     ReplicaUnavailableError,
     RetryableTransportError,
     classify_exception,
+    is_caller_timeout,
 )
 from bioengine_tpu.serving.remote import RemoteReplica
+from bioengine_tpu.serving.scheduler import (
+    DeploymentScheduler,
+    HeuristicCostModel,
+    SchedulingConfig,
+)
 from bioengine_tpu.serving.replica import (
     CHIP_SECONDS,
     ROUTABLE_STATES,
@@ -150,7 +157,13 @@ class RequestOptions:
     on another healthy replica with exponential backoff + full jitter.
     Non-idempotent calls surface the first transport error exactly
     once, typed (``RetryableTransportError``) — never silently retried,
-    because the outcome on the dead replica is ambiguous."""
+    because the outcome on the dead replica is ambiguous.
+
+    ``priority`` and ``tenant`` only matter on deployments with a
+    global scheduler attached: the priority class picks the
+    weighted-fair queue (``interactive`` / ``bulk`` / ``background`` by
+    default) and the tenant id counts against the per-tenant admission
+    quota."""
 
     timeout_s: Optional[float] = None
     deadline_s: Optional[float] = None
@@ -158,6 +171,8 @@ class RequestOptions:
     max_attempts: int = 4
     backoff_base_s: float = 0.05
     backoff_cap_s: float = 2.0
+    priority: Optional[str] = None     # scheduler class; None = default
+    tenant: Optional[str] = None       # admission quota bucket
 
     @classmethod
     def from_env(cls) -> "RequestOptions":
@@ -195,6 +210,26 @@ class DeploymentSpec:
     # deployment on a REMOTE worker host — set by AppBuilder; None means
     # the deployment can only be placed locally
     remote_payload: Optional[dict] = None
+    # replica-side ContinuousBatcher knobs, surfaced from the manifest
+    # (deployment_config.<dep>.batching) and injected into the instance
+    # as ``bioengine_batch_config`` before async_init; None keeps the
+    # instance's own defaults
+    max_batch: Optional[int] = None
+    max_wait_ms: Optional[float] = None
+    # opt-in global scheduler (cross-replica batching + admission
+    # control + predictive autoscaling); None keeps the per-request
+    # router path
+    scheduling: Optional[SchedulingConfig] = None
+
+    def batch_config(self) -> Optional[dict]:
+        if self.max_batch is None and self.max_wait_ms is None:
+            return None
+        out: dict = {}
+        if self.max_batch is not None:
+            out["max_batch"] = int(self.max_batch)
+        if self.max_wait_ms is not None:
+            out["max_wait_ms"] = float(self.max_wait_ms)
+        return out
 
 
 @dataclass
@@ -310,6 +345,10 @@ class DeploymentHandle:
                 FailureKind.APPLICATION: "app_error",
                 FailureKind.DEADLINE: "deadline",
             }.get(kind, "transport_error")
+            if isinstance(e, AdmissionRejectedError):
+                # load shedding is its own outcome: an SLO dashboard
+                # must tell "we said no" apart from "the app broke"
+                outcome = "rejected"
             if kind is FailureKind.DEADLINE:
                 # the evidence of WHY the budget was blown (breaker
                 # trips, re-placements, parks) is in the ring right now
@@ -390,37 +429,58 @@ class DeploymentHandle:
                     f"deadline exhausted after {attempt - 1} attempt(s) "
                     f"for {self.app_id}/{self.deployment}.{method}"
                 )
-            t_route = time.monotonic()
-            with tracing.trace_span(
-                "route", app=self.app_id, deployment=self.deployment
-            ):
-                replica = await self._controller._pick_replica_wait(
-                    self.app_id, self.deployment, avoid=tried, deadline=deadline
-                )
-            if metrics.metrics_enabled():
-                self._m_route_wait.observe(time.monotonic() - t_route)
-            # the wait above may have parked through most of the budget
-            # — recompute so the attempt (and the host-side timeout it
-            # propagates) cannot overrun the overall deadline
-            if deadline is not None:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise DeadlineExceeded(
-                        f"deadline exhausted while waiting for a replica "
-                        f"of {self.app_id}/{self.deployment}"
+            scheduler = self._controller._schedulers.get(key)
+            replica = None
+            if scheduler is None:
+                t_route = time.monotonic()
+                with tracing.trace_span(
+                    "route", app=self.app_id, deployment=self.deployment
+                ):
+                    replica = await self._controller._pick_replica_wait(
+                        self.app_id, self.deployment, avoid=tried,
+                        deadline=deadline,
                     )
+                if metrics.metrics_enabled():
+                    self._m_route_wait.observe(time.monotonic() - t_route)
+                # the wait above may have parked through most of the
+                # budget — recompute so the attempt (and the host-side
+                # timeout it propagates) cannot overrun the deadline
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise DeadlineExceeded(
+                            f"deadline exhausted while waiting for a replica "
+                            f"of {self.app_id}/{self.deployment}"
+                        )
             budget = _min_defined(options.timeout_s, remaining)
             self._controller._queue_depth[key] += 1
             try:
                 with tracing.trace_span(
                     "attempt",
-                    replica=replica.replica_id,
+                    replica=replica.replica_id if replica else "scheduler",
                     attempt=attempt,
                 ):
-                    result = await replica.call_bounded(
-                        method, args, kwargs, timeout_s=budget
-                    )
-                self._controller._breaker_success(replica)
+                    if scheduler is None:
+                        result = await replica.call_bounded(
+                            method, args, kwargs, timeout_s=budget
+                        )
+                    else:
+                        # the scheduler owns admission, fair queueing,
+                        # group coalescing, and the scored replica pick
+                        # for this attempt; breaker bookkeeping happens
+                        # inside its dispatch (it saw the replica, we
+                        # did not)
+                        result = await scheduler.submit(
+                            method,
+                            args,
+                            kwargs,
+                            options=options,
+                            timeout_s=budget,
+                            deadline=deadline,
+                            avoid=frozenset(tried),
+                        )
+                if replica is not None:
+                    self._controller._breaker_success(replica)
                 return result
             except Exception as e:
                 kind = classify_exception(e)
@@ -429,13 +489,17 @@ class DeploymentHandle:
                 # a timeout of the CALLER's own budget says nothing
                 # about replica health — only genuine transport/placement
                 # failures feed the circuit breaker
-                caller_timeout = isinstance(e, asyncio.TimeoutError) or (
-                    isinstance(e, RemoteError)
-                    and e.type_name == "TimeoutError"
-                )
-                if not caller_timeout:
+                if replica is not None and not is_caller_timeout(e):
                     self._controller._breaker_failure(replica, e)
-                tried.add(replica.replica_id)
+                # scheduler-dispatched failures stamp the serving
+                # replica on the exception so failover can avoid it
+                rid = (
+                    replica.replica_id
+                    if replica is not None
+                    else getattr(e, "replica_id", None)
+                )
+                if rid is not None:
+                    tried.add(rid)
                 if isinstance(e, DeadlineExceeded):
                     raise
                 remaining = (
@@ -457,7 +521,7 @@ class DeploymentHandle:
                 if not options.idempotent and not not_executed:
                     raise RetryableTransportError(
                         f"{self.app_id}/{self.deployment}.{method} failed in "
-                        f"transport on {replica.replica_id} (non-idempotent "
+                        f"transport on {rid or 'scheduler'} (non-idempotent "
                         f"call, not retried): {e}"
                     ) from e
                 if attempt >= options.max_attempts:
@@ -473,7 +537,7 @@ class DeploymentHandle:
                     app=self.app_id,
                     deployment=self.deployment,
                     method=method,
-                    replica=replica.replica_id,
+                    replica=rid,
                     attempt=attempt,
                     error=str(e)[:300],
                 )
@@ -486,7 +550,21 @@ class DeploymentHandle:
                     delay = min(delay, max(0.0, remaining))
                 await asyncio.sleep(delay)
             finally:
-                self._controller._queue_depth[key] -= 1
+                # router-state leak discipline: undeploy sweeps this
+                # entry, but an in-flight retry's increment (defaultdict)
+                # can resurrect it — so the decrement clamps at zero
+                # (never a persistent negative, even when old-generation
+                # decrements interleave with a redeploy) and a key whose
+                # app is gone is swept here instead of lingering
+                depth = self._controller._queue_depth
+                if key in depth:
+                    if depth[key] > 0:
+                        depth[key] -= 1
+                    if (
+                        depth[key] <= 0
+                        and self.app_id not in self._controller.apps
+                    ):
+                        depth.pop(key, None)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
@@ -535,6 +613,12 @@ class ServeController:
         self._queue_depth: dict[tuple[str, str], int] = defaultdict(int)
         self._rr_counters: dict[tuple[str, str], itertools.count] = {}
         self._breaker_counts: dict[str, int] = {}
+        # global schedulers, one per deployment that opted in via
+        # DeploymentSpec.scheduling; created at deploy, closed at
+        # undeploy. scorer_factory is the pluggable placement policy —
+        # swap in a learned scorer without touching the scheduler.
+        self._schedulers: dict[tuple[str, str], DeploymentScheduler] = {}
+        self.scorer_factory: Callable[[], Any] = HeuristicCostModel
         self._replicas_changed = asyncio.Event()
         self._rpc_server = None            # set by attach_rpc (multi-host)
         self._router_admins: list[str] = []
@@ -683,6 +767,17 @@ class ServeController:
         try:
             for spec in specs:
                 app.replicas[spec.name] = []
+                if spec.scheduling is not None and spec.scheduling.enabled:
+                    self._schedulers[(app_id, spec.name)] = (
+                        DeploymentScheduler(
+                            self,
+                            app_id,
+                            spec.name,
+                            spec,
+                            spec.scheduling,
+                            scorer=self.scorer_factory(),
+                        )
+                    )
                 for _ in range(spec.num_replicas):
                     await self._add_replica(app, spec)
             app.status = "RUNNING"
@@ -691,6 +786,10 @@ class ServeController:
             # Roll back partial state: stop started replicas and release
             # their chip leases so a failed deploy leaks nothing.
             app.status = "DEPLOY_FAILED"
+            for spec in specs:
+                sched = self._schedulers.pop((app_id, spec.name), None)
+                if sched is not None:
+                    await sched.close()
             for replicas in app.replicas.values():
                 for r in replicas:
                     try:
@@ -740,6 +839,7 @@ class ServeController:
                 instance_factory=spec.instance_factory,
                 max_ongoing_requests=spec.max_ongoing_requests,
                 log_sink=self.cluster_state.append_replica_log,
+                batch_config=spec.batch_config(),
             )
             if spec.chips_per_replica > 0:
                 replica.device_ids = self.cluster_state.acquire_chips(
@@ -855,6 +955,13 @@ class ServeController:
         app = self.apps.pop(app_id, None)
         if app is None:
             return
+        # schedulers close FIRST: queued requests fail fast (typed) and
+        # already-dispatched groups drain against replicas that are
+        # still routable for a moment longer
+        for name in app.specs:
+            sched = self._schedulers.pop((app_id, name), None)
+            if sched is not None:
+                await sched.close()
         # drain-then-stop every replica concurrently: new calls are
         # rejected the moment states flip to DRAINING, in-flight
         # requests get up to drain_timeout_s to finish
@@ -865,6 +972,12 @@ class ServeController:
                 for r in replicas
             )
         )
+        # router-state leak fix: get_handle/_pick_replica seeded
+        # per-deployment entries that previously outlived the app —
+        # unbounded growth under deploy/undeploy churn
+        for name in app.specs:
+            self._queue_depth.pop((app_id, name), None)
+            self._rr_counters.pop((app_id, name), None)
         app.status = "STOPPED"
         self.logger.info(f"app '{app_id}' undeployed")
 
@@ -1149,6 +1262,12 @@ class ServeController:
         ]
         if not healthy:
             return
+        scheduler = self._schedulers.get((app.app_id, spec.name))
+        if scheduler is not None:
+            await self._autoscale_predictive(
+                app, spec, scheduler, healthy, replicas
+            )
+            return
         avg_load = sum(r.load for r in healthy) / len(healthy)
         depth = self._queue_depth.get((app.app_id, spec.name), 0)
         if (
@@ -1175,6 +1294,46 @@ class ServeController:
                 victim = idle[-1]
                 self.logger.info(
                     f"autoscale DOWN {app.app_id}/{spec.name} "
+                    f"({victim.replica_id})"
+                )
+                app.replicas[spec.name].remove(victim)
+                await self._retire_replica(victim)
+
+    async def _autoscale_predictive(
+        self,
+        app: AppDeployment,
+        spec: DeploymentSpec,
+        scheduler: DeploymentScheduler,
+        healthy: list,
+        replicas: list,
+    ) -> None:
+        """Scheduler-backed deployments scale on the predictor's
+        verdict: up when measured arrival rate x service time projects
+        a wait over the threshold (BEFORE queues saturate — occupancy
+        alone reacts after), down only after the configured hysteresis
+        of consecutive idle verdicts, riding the same drain machinery
+        as undeploy so in-flight work is never cut."""
+        decision, proj = scheduler.scale_decision(len(healthy))
+        if decision == "up" and len(replicas) < spec.max_replicas:
+            self.logger.info(
+                f"predictive autoscale UP {app.app_id}/{spec.name} "
+                f"(projected_wait={proj['projected_wait_s']:.3f}s, "
+                f"utilization={proj['utilization']:.2f}, "
+                f"rate={proj['arrival_rate']:.1f}/s)"
+            )
+            try:
+                await self._add_replica(app, spec)
+                self._replicas_changed.set()
+            except Exception as e:  # noqa: BLE001 — capacity may come later
+                self.logger.warning(f"predictive autoscale up blocked: {e}")
+        elif decision == "down" and len(healthy) > spec.min_replicas:
+            # only a fully idle replica may be retired; prefer the
+            # youngest so long-warm program caches survive
+            idle = [r for r in healthy if r.load == 0.0]
+            if idle:
+                victim = idle[-1]
+                self.logger.info(
+                    f"predictive autoscale DOWN {app.app_id}/{spec.name} "
                     f"({victim.replica_id})"
                 )
                 app.replicas[spec.name].remove(victim)
@@ -1234,8 +1393,10 @@ class ServeController:
         # UNKNOWN, so the rollup reports None rather than coercing to
         # 0 and faking an idle queue to least-loaded routing decisions
         queued = [d.get("queued_requests") for d in described]
+        scheduler = self._schedulers.get((app_id, name))
         return {
             "num_replicas": len(replicas),
+            "scheduler": scheduler.describe() if scheduler else None,
             "replicas": described,
             "queue_depth": self._queue_depth.get((app_id, name), 0),
             "outstanding_calls": sum(

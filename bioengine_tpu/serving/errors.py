@@ -56,6 +56,21 @@ class ApplicationError(Exception):
     an explicitly-final error through the retry layer.)"""
 
 
+class AdmissionRejectedError(RuntimeError):
+    """The global scheduler shed this request at admission (queue depth
+    over budget, tenant quota exhausted, or a deadline that could never
+    be met). Deliberately NOT retryable: the rejection is the
+    deployment-wide backpressure signal — retrying against the same
+    saturated queue (or failing over to a sibling replica of the same
+    deployment) cannot help; back off at the client instead.
+    ``reason`` is one of ``queue_full`` / ``tenant_quota`` /
+    ``deadline_infeasible``."""
+
+    def __init__(self, message: str, reason: str = "queue_full"):
+        super().__init__(message)
+        self.reason = reason
+
+
 class DeadlineExceeded(asyncio.TimeoutError):
     """The request's overall deadline expired (including any failover
     backoff)."""
@@ -89,7 +104,9 @@ def classify_exception(exc: BaseException) -> FailureKind:
     """Map an exception from a replica call to its failure class."""
     if isinstance(exc, DeadlineExceeded):
         return FailureKind.DEADLINE
-    if isinstance(exc, ApplicationError):
+    if isinstance(exc, (ApplicationError, AdmissionRejectedError)):
+        # admission rejection is terminal backpressure, not a transport
+        # fault — the retry layer must surface it, never fail it over
         return FailureKind.APPLICATION
     if isinstance(exc, (RetryableTransportError, ConnectionError)):
         return FailureKind.TRANSPORT
@@ -113,3 +130,17 @@ def classify_exception(exc: BaseException) -> FailureKind:
 
 def is_retryable(exc: BaseException) -> bool:
     return classify_exception(exc) is FailureKind.TRANSPORT
+
+
+def is_caller_timeout(exc: BaseException) -> bool:
+    """The CALLER's own time budget expired — locally
+    (``asyncio.TimeoutError``, incl. :class:`DeadlineExceeded`) or
+    enforced host-side and returned over the wire
+    (``RemoteError('TimeoutError')``). Retry rules treat it like
+    transport (outcome ambiguous), but it is NOT replica-health
+    evidence: an impatient client must never feed the circuit breaker.
+    This predicate is the ONE definition of that breaker discipline —
+    router, scheduler fast path, and group dispatch all call it."""
+    return isinstance(exc, asyncio.TimeoutError) or (
+        isinstance(exc, RemoteError) and exc.type_name == "TimeoutError"
+    )

@@ -243,6 +243,55 @@ class RemoteReplica(ReplicaStateMixin):
             if self._ongoing == 0:
                 self._idle_event.set()
 
+    async def call_batch(
+        self,
+        method: str,
+        requests: list,
+        timeout_s: Optional[float] = None,
+    ) -> list:
+        """A controller-coalesced group as ONE wire round trip: the
+        ``__batch__`` verb carries all K member payloads in a single
+        ``replica_call`` frame, the host fans them out through the
+        replica's normal per-call path (where the instance's own
+        batcher merges them into one forward), and K result envelopes
+        ride back in one frame — K requests, one round trip."""
+        if self.state not in ROUTABLE_STATES:
+            raise ReplicaUnavailableError(
+                f"replica {self.replica_id} not healthy ({self.state})"
+            )
+        n = len(requests)
+        self._ongoing += n
+        self._idle_event.clear()
+        self._total_requests += n
+        try:
+            extra: dict = {}
+            if timeout_s is not None:
+                extra = {"timeout_s": timeout_s, "rpc_timeout": timeout_s + 5.0}
+            with tracing.trace_span(
+                "remote.call",
+                replica=self.replica_id,
+                host=self.host_id,
+                method=method,
+                batch=n,
+            ):
+                return await self._call_host(
+                    self.host_service_id,
+                    "replica_call",
+                    self.replica_id,
+                    "__batch__",
+                    [method, requests],
+                    {},
+                    **extra,
+                )
+        except KeyError as e:
+            raise ReplicaUnavailableError(
+                f"host '{self.host_id}' service vanished: {e}"
+            ) from e
+        finally:
+            self._ongoing -= n
+            if self._ongoing == 0:
+                self._idle_event.set()
+
     @property
     def load(self) -> float:
         return self._ongoing / max(1, self.max_ongoing_requests)

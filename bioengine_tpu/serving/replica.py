@@ -117,6 +117,7 @@ class Replica(ReplicaStateMixin):
         max_ongoing_requests: int = 10,
         log_sink: Optional[Callable[[str, str], None]] = None,
         drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S,
+        batch_config: Optional[dict] = None,
     ):
         self.app_id = app_id
         self.deployment_name = deployment_name
@@ -125,6 +126,7 @@ class Replica(ReplicaStateMixin):
         self.state = ReplicaState.STARTING
         self.max_ongoing_requests = max_ongoing_requests
         self.drain_timeout_s = drain_timeout_s
+        self.batch_config = dict(batch_config) if batch_config else None
         self._instance_factory = instance_factory
         self.instance: Any = None
         self._semaphore = asyncio.Semaphore(max_ongoing_requests)
@@ -187,6 +189,21 @@ class Replica(ReplicaStateMixin):
                         "could not inject device lease "
                         f"{list(self.device_ids)} into instance ({e}); "
                         "replica will run single-device"
+                    )
+            if self.batch_config:
+                # operator-tuned batching knobs from the deployment
+                # spec/manifest, injected BEFORE async_init (same
+                # contract as the device lease) so instances that build
+                # a ContinuousBatcher there pick them up instead of
+                # their constructor defaults
+                try:
+                    self.instance.bioengine_batch_config = dict(
+                        self.batch_config
+                    )
+                except Exception as e:  # noqa: BLE001 — slots/frozen instances opt out
+                    self._log(
+                        f"could not inject batch config "
+                        f"{self.batch_config} into instance ({e})"
                     )
             if hasattr(self.instance, "async_init"):
                 await _maybe_await(self.instance.async_init())
@@ -409,6 +426,46 @@ class Replica(ReplicaStateMixin):
         if timeout_s is None:
             return await coro
         return await asyncio.wait_for(coro, timeout_s)
+
+    async def call_batch(
+        self,
+        method: str,
+        requests: list,
+        timeout_s: Optional[float] = None,
+        wire: bool = False,
+    ) -> list:
+        """Execute a controller-coalesced group of compatible calls.
+        Each member runs the NORMAL per-call path (semaphore slot,
+        routability re-check, metrics, chip accounting) concurrently —
+        so all K land in the same event-loop window and an instance
+        with its own ``ContinuousBatcher`` merges them into one forward
+        — while per-member failures stay isolated: one member's
+        exception never poisons its groupmates. Returns one envelope
+        per request, in order: ``{"ok": True, "result": ...}`` or a
+        failure carrying the real exception object (in-process path) /
+        its type name + message (``wire=True``, the ``__batch__`` RPC
+        verb — the same type-name contract RemoteError classification
+        already rides)."""
+
+        async def one(r: dict) -> dict:
+            try:
+                result = await self.call(
+                    method, *(r.get("args") or ()), **(r.get("kwargs") or {})
+                )
+                return {"ok": True, "result": result}
+            except Exception as e:  # noqa: BLE001 — per-member isolation is the point
+                if wire:
+                    return {
+                        "ok": False,
+                        "type": type(e).__name__,
+                        "error": str(e),
+                    }
+                return {"ok": False, "exception": e}
+
+        gathered = asyncio.gather(*(one(r) for r in requests))
+        if timeout_s is None:
+            return await gathered
+        return await asyncio.wait_for(gathered, timeout_s)
 
     @property
     def load(self) -> float:

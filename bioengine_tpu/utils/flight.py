@@ -20,6 +20,10 @@ points the serving/rpc/runtime layers already own:
 ``request.failover``        an attempt retried on another replica
 ``request.slow``            a call crossed BIOENGINE_SLOW_REQUEST_MS
 ``deadline.exceeded``       a request exhausted its deadline (auto-dump)
+``admission.reject``        the global scheduler shed a request (reason:
+                            queue_full / tenant_quota / deadline_infeasible)
+``scale.predict``           the predictive autoscaler fired (direction +
+                            the projection that justified it)
 ``host.join`` / ``host.dead``  worker host joined / pruned by the controller
 ``host.rejoin``             worker host reconciled after a connection blip
 ``client.disconnect`` / ``client.reconnect``  RPC client connection events

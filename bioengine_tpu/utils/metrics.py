@@ -47,6 +47,12 @@ LATENCY_BUCKETS_S = (
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
 )
 
+# Batch-occupancy buckets (requests per dispatched group) for the
+# scheduler_* family: powers of two up to the largest group any ladder
+# bucket realistically pads to — occupancy is the lever cross-replica
+# coalescing exists to move, so it gets first-class edges.
+BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
 
 class _Child:
     """One labeled series. Base for Counter/Gauge children."""

@@ -322,6 +322,11 @@ class WorkerHost:
             instance_factory=spec.instance_factory,
             device_ids=list(device_ids or []),
             max_ongoing_requests=max_ongoing_requests,
+            # the shipped manifest carries the operator's batching knobs
+            # (deployment_config.<dep>.batching) — the host-side build
+            # re-derives the same spec, so remote replicas honor them
+            # identically to local ones
+            batch_config=spec.batch_config(),
         )
         replica.replica_id = replica_id  # controller's id IS the identity
         try:
@@ -357,9 +362,18 @@ class WorkerHost:
             await faults.hit(
                 "host.replica_call", drop=self._abort_connection
             )
-        coro = self._get(replica_id).call(
-            method, *(args or []), **(kwargs or {})
-        )
+        replica = self._get(replica_id)
+        if method == "__batch__":
+            # a controller-coalesced group: args = [real_method,
+            # [member payloads]]; the host fans members out through the
+            # replica's normal per-call path and returns wire-safe
+            # per-member envelopes in the same RESULT frame — K
+            # requests, one round trip
+            real_method, requests = args[0], args[1]
+            return await replica.call_batch(
+                real_method, requests, timeout_s=timeout_s, wire=True
+            )
+        coro = replica.call(method, *(args or []), **(kwargs or {}))
         if timeout_s is None:
             return await coro
         return await asyncio.wait_for(coro, timeout_s)

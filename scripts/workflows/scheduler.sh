@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# Global-scheduler gate: admission/fairness/coalescing/predictive-
+# autoscale unit layers, the cross-host __batch__ round-trip tests, and
+# the mixed-priority soak (2 scheduled apps x 2 replicas over real
+# websockets, one host killed mid-soak) at a higher request count than
+# tier-1 runs — then a scheduler_goodput bench smoke asserting the
+# stage emits its schema with zero failed requests and a non-degraded
+# batch occupancy.
+#
+# Knobs:
+#   BIOENGINE_SCHED_SOAK_N   requests per soak worker stream (default 25 here)
+set -euo pipefail
+
+cd "$(dirname "$0")/../.."
+
+export JAX_PLATFORMS=cpu
+export BIOENGINE_SCHED_SOAK_N="${BIOENGINE_SCHED_SOAK_N:-25}"
+
+echo "== scheduler test suite (soak streams: ${BIOENGINE_SCHED_SOAK_N} req/worker) =="
+timeout -k 10 600 python -m pytest tests/test_scheduler.py -q -rA \
+    -p no:cacheprovider
+
+echo "== scheduler_goodput bench smoke =="
+out="$(mktemp)"
+timeout -k 10 300 env BENCH_PLATFORM=cpu BENCH_DEADLINE=240 \
+    BENCH_CONFIGS=scheduler_goodput python bench.py | tail -n1 > "$out"
+python - "$out" <<'EOF'
+import json
+import sys
+
+with open(sys.argv[1]) as f:
+    d = json.loads(f.read())
+st = d["extra"]["scheduler_goodput"]
+assert st and st.get("ok"), st
+for leg in ("router", "scheduler"):
+    assert st["legs"][leg]["failed"] == 0, (leg, st["legs"][leg])
+    assert st["legs"][leg]["goodput_rps"] > 0, (leg, st["legs"][leg])
+# the mechanism gate: coalescing must not LOWER occupancy vs the
+# per-request router on the same workload (the goodput headline is a
+# hardware number; CI cores are too noisy to gate on it)
+assert (
+    st["legs"]["scheduler"]["batch_occupancy"]
+    >= st["legs"]["router"]["batch_occupancy"]
+), st["legs"]
+print(
+    f"scheduler_goodput OK: speedup={st['goodput_speedup']} "
+    f"occupancy_gain={st['occupancy_gain']} "
+    f"uncontended_overhead={st['uncontended']['overhead_scheduler_pct']}%"
+)
+EOF
+
+echo "scheduler gate OK"

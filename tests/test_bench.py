@@ -197,6 +197,67 @@ def test_observability_overhead_stage_schema():
     )
 
 
+def test_scheduler_goodput_stage_schema():
+    """Pin the scheduler_goodput artifact schema: per-request router vs
+    global scheduler on the same mixed-priority workload (goodput, per
+    class p50/p99, SLO attainment, batch occupancy) plus the
+    interleaved uncontended leg (the <2% scheduler-overhead acceptance
+    gate reads overhead_scheduler_pct from the full-size driver run — a
+    loaded CI core would flake a hard threshold here, so schema and
+    sanity ordering are the contract)."""
+    proc, lines = _run(
+        {
+            "BENCH_CONFIGS": "scheduler_goodput",
+            "BENCH_DEADLINE": "170",
+            "BENCH_SCHED_ROUNDS": "1",
+            "BENCH_SCHED_WAVES": "6",
+            "BENCH_SCHED_SOLO": "12",
+        },
+        timeout=200.0,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    st = json.loads(lines[-1])["extra"]["scheduler_goodput"]
+    assert st["ok"], st
+    for key in (
+        "workload",
+        "legs",
+        "goodput_speedup",
+        "occupancy_gain",
+        "uncontended",
+    ):
+        assert key in st, key
+    for leg in ("router", "scheduler"):
+        d = st["legs"][leg]
+        for key in (
+            "goodput_rps",
+            "interactive_p50_ms",
+            "interactive_p99_ms",
+            "interactive_slo_met_pct",
+            "bulk_p50_ms",
+            "bulk_p99_ms",
+            "batch_occupancy",
+            "failed",
+        ):
+            assert key in d, (leg, key)
+        assert d["goodput_rps"] > 0, leg
+        assert d["failed"] == 0, (leg, d)
+    # the same workload ran both ways; coalescing must raise occupancy
+    # (the mechanism — the goodput consequence is a hardware number)
+    assert (
+        st["legs"]["scheduler"]["batch_occupancy"]
+        >= st["legs"]["router"]["batch_occupancy"]
+    ), st["legs"]
+    unc = st["uncontended"]
+    for key in (
+        "router_p50_us",
+        "scheduler_p50_us",
+        "overhead_scheduler_pct",
+        "overhead_scheduler_abs_us",
+    ):
+        assert key in unc, key
+    assert unc["router_p50_us"] > 0 and unc["scheduler_p50_us"] > 0
+
+
 def test_stalled_worker_killed_with_diagnostics_never_rc124():
     # the env-gated 'sleep' stage hangs mid-stage DETERMINISTICALLY (no
     # dependence on compile latency or a warm compilation cache), so a
