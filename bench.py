@@ -1050,6 +1050,14 @@ def _bench_observability(cpu: bool) -> dict:  # noqa: ARG001 — pure host path
       ``overhead_flight_vs_unsampled_pct`` < 1 — the ring writes only
       on failure/transition edges, so the per-request cost is the
       enabled-checks)
+    - ``telem``     — flight + the telemetry history pipeline running
+      HOT: the controller's registry-delta sampler ticking plus a
+      simulated worker-host push ingested every interval
+      (BENCH_TELEM_INTERVAL, default 0.25 s — 40x the production 10 s
+      cadence). The acceptance gate reads
+      ``overhead_telem_vs_flight_pct`` < 1: history is scrape-time
+      work off the request path, so the per-request cost must be
+      event-loop noise only.
     - ``sampled``   — sampling 1.0 (the ceiling: full span recording
       + chip-seconds stamped on the trace root)
 
@@ -1094,6 +1102,7 @@ def _bench_observability(cpu: bool) -> dict:  # noqa: ARG001 — pure host path
             "BIOENGINE_FLIGHT": "0",
         },
         "flight": {"BIOENGINE_TRACE_SAMPLE": "0.0"},
+        "telem": {"BIOENGINE_TRACE_SAMPLE": "0.0"},
         "sampled": {"BIOENGINE_TRACE_SAMPLE": "1.0"},
     }
     knobs = [
@@ -1123,14 +1132,53 @@ def _bench_observability(cpu: bool) -> dict:  # noqa: ARG001 — pure host path
             for _ in range(per_round):  # warmup
                 await handle.call("infer")
 
+            from bioengine_tpu.utils import telemetry as _telemetry
+
+            telem_interval = float(
+                os.environ.get("BENCH_TELEM_INTERVAL", "0.25")
+            )
+            host_sampler = _telemetry.RegistrySampler()
+            host_sampler.source_id = "bench-host"  # never deduped as local
+
+            async def telem_load(stop: asyncio.Event) -> None:
+                # the telemetry pipeline under push load: the
+                # controller's own tick plus a worker-host-shaped push
+                # ingested each interval — everything the telem1 plane
+                # does except the websocket hop (measured by the
+                # rpc_transport stage; here the question is what
+                # HISTORY costs the serve hot path)
+                host_sampler.sample()
+                while not stop.is_set():
+                    controller.telemetry_tick()
+                    snap = host_sampler.sample()
+                    if snap:
+                        controller.telemetry.ingest(
+                            snap, host_id="bench-host"
+                        )
+                    try:
+                        await asyncio.wait_for(stop.wait(), telem_interval)
+                    except asyncio.TimeoutError:
+                        pass
+
             times: dict[str, list] = {name: [] for name in legs}
             for _ in range(rounds):
                 for name, env in legs.items():
                     _apply(env)
-                    for _ in range(per_round):
-                        t0 = time.perf_counter()
-                        await handle.call("infer")
-                        times[name].append(time.perf_counter() - t0)
+                    telem_stop = asyncio.Event()
+                    telem_task = (
+                        asyncio.ensure_future(telem_load(telem_stop))
+                        if name == "telem"
+                        else None
+                    )
+                    try:
+                        for _ in range(per_round):
+                            t0 = time.perf_counter()
+                            await handle.call("infer")
+                            times[name].append(time.perf_counter() - t0)
+                    finally:
+                        if telem_task is not None:
+                            telem_stop.set()
+                            await telem_task
                     if name == "sampled":
                         tracing.clear_spans()
         finally:
@@ -1152,7 +1200,7 @@ def _bench_observability(cpu: bool) -> dict:  # noqa: ARG001 — pure host path
             "legs": {name: {"p50_us": p50_us(v)} for name, v in times.items()},
         }
         base = out["legs"]["disabled"]["p50_us"]
-        for name in ("unsampled", "flight", "sampled"):
+        for name in ("unsampled", "flight", "telem", "sampled"):
             leg = out["legs"][name]["p50_us"]
             out[f"overhead_{name}_pct"] = round(100.0 * (leg - base) / base, 2)
             out[f"overhead_{name}_abs_us"] = round(leg - base, 1)
@@ -1163,15 +1211,26 @@ def _bench_observability(cpu: bool) -> dict:  # noqa: ARG001 — pure host path
         out["overhead_flight_vs_unsampled_pct"] = round(
             100.0 * (flight_leg - unsampled) / unsampled, 2
         )
+        # the push-telemetry acceptance gate: history pipeline hot vs
+        # the flight leg it rides on (gate < 1 on the driver run)
+        telem_leg = out["legs"]["telem"]["p50_us"]
+        out["overhead_telem_vs_flight_pct"] = round(
+            100.0 * (telem_leg - flight_leg) / flight_leg, 2
+        )
+        out["telem_interval_s"] = telem_interval
         out["note"] = (
             "unsampled = PR-6 default (tracing on, 0% head sampling, "
             "metrics on, flight ring off); flight = that plus the "
             "always-on flight recorder (PR-7 default, gate: "
             "overhead_flight_vs_unsampled_pct < 1 — the ring only "
-            "writes on failure/transition edges); overhead vs the "
-            "fully-disabled PR-5 hot path must sit within measurement "
-            "noise (<2%). abs_us is workload-independent — the "
-            "per-request cost of the substrate itself"
+            "writes on failure/transition edges); telem = flight plus "
+            "the telemetry history pipeline ticking at 40x production "
+            "cadence (PR-10 default, gate: "
+            "overhead_telem_vs_flight_pct < 1 — history is scrape-time "
+            "work off the request path); overhead vs the fully-disabled "
+            "PR-5 hot path must sit within measurement noise (<2%). "
+            "abs_us is workload-independent — the per-request cost of "
+            "the substrate itself"
         )
         return out
 
@@ -1813,11 +1872,166 @@ def _final_json(shared: _Shared, deadline_hit: bool) -> str:
     )
 
 
+# ---------------------------------------------------------------------------
+# --compare: regression-diff two bench artifacts (the tracked gate the
+# empty bench trajectory becomes — CI/driver can fail a PR on a perf
+# regression instead of eyeballing JSON)
+# ---------------------------------------------------------------------------
+
+# direction inference by key substring: which way is better. Checked in
+# order (higher-is-better first: "images_per_sec" must not match "_s").
+_COMPARE_HIGHER = (
+    "per_sec", "per_chip", "speedup", "goodput", "efficiency", "recall",
+    "slo_met", "occupancy", "mb_per_sec", "hit_rate",
+)
+_COMPARE_LOWER = (
+    "_ms", "_us", "p50", "p95", "p99", "latency", "overhead", "seconds",
+    "_s", "bytes",
+)
+
+_COMPARE_SKIP_KEYS = {
+    "attempts", "diagnostics", "skipped", "note", "probe", "requests_per_leg",
+    "deadline_hit", "workload", "depth", "batch", "n_devices", "image_hw",
+    "sizes_mb", "telem_interval_s",
+}
+
+
+def _compare_direction(key: str):
+    """'higher' | 'lower' | None (informational-only metric)."""
+    k = key.lower()
+    for frag in _COMPARE_HIGHER:
+        if frag in k:
+            return "higher"
+    for frag in _COMPARE_LOWER:
+        if frag in k:
+            return "lower"
+    return None
+
+
+def _numeric_leaves(obj, prefix: str = "") -> dict:
+    """Flatten a stage record to dotted-path -> float, skipping
+    bookkeeping keys and non-numeric values."""
+    out: dict = {}
+    if not isinstance(obj, dict):
+        return out
+    for key, value in obj.items():
+        if key in _COMPARE_SKIP_KEYS or key == "ok":
+            continue
+        path = f"{prefix}.{key}" if prefix else key
+        if isinstance(value, bool):
+            continue
+        if isinstance(value, (int, float)):
+            out[path] = float(value)
+        elif isinstance(value, dict):
+            out.update(_numeric_leaves(value, path))
+    return out
+
+
+def compare_main(argv) -> int:
+    """``bench.py --compare A.json B.json [--tolerance-pct N]``:
+    regression-diff two bench artifacts (A = baseline, B = candidate).
+    Per shared stage, every numeric metric with an inferable direction
+    gets a delta; a metric worse by more than the tolerance flags a
+    regression and the exit code goes non-zero. Prints exactly one
+    JSON line (the same contract as a measuring run)."""
+    args = [a for a in argv[1:] if a != "--compare"]
+    tolerance = 10.0
+    if "--tolerance-pct" in args:
+        i = args.index("--tolerance-pct")
+        tolerance = float(args[i + 1])
+        del args[i : i + 2]
+    if len(args) != 2:
+        print(
+            json.dumps(
+                {
+                    "ok": False,
+                    "error": "usage: bench.py --compare A.json B.json "
+                    "[--tolerance-pct N]",
+                }
+            )
+        )
+        return 2
+    with open(args[0]) as f:
+        a = json.load(f)
+    with open(args[1]) as f:
+        b = json.load(f)
+
+    def stages(artifact) -> dict:
+        out = {}
+        for name, rec in (artifact.get("extra") or {}).items():
+            if isinstance(rec, dict) and rec.get("ok"):
+                out[name] = rec
+        if artifact.get("value"):
+            out["headline"] = {
+                "images_per_sec_per_chip": float(artifact["value"])
+            }
+        return out
+
+    sa, sb = stages(a), stages(b)
+    report: dict = {}
+    regressions: list = []
+    improvements: list = []
+    for stage in sorted(set(sa) & set(sb)):
+        la, lb = _numeric_leaves(sa[stage]), _numeric_leaves(sb[stage])
+        stage_out: dict = {}
+        for metric in sorted(set(la) & set(lb)):
+            va, vb = la[metric], lb[metric]
+            direction = _compare_direction(metric)
+            delta_pct = (
+                round(100.0 * (vb - va) / abs(va), 2) if va else None
+            )
+            entry = {
+                "a": va,
+                "b": vb,
+                "delta_pct": delta_pct,
+                "direction": direction,
+            }
+            if direction is not None and delta_pct is not None:
+                worse = (
+                    delta_pct < -tolerance
+                    if direction == "higher"
+                    else delta_pct > tolerance
+                )
+                better = (
+                    delta_pct > tolerance
+                    if direction == "higher"
+                    else delta_pct < -tolerance
+                )
+                entry["regression"] = worse
+                ref = f"{stage}.{metric}"
+                if worse:
+                    regressions.append(
+                        {"metric": ref, "delta_pct": delta_pct, **entry}
+                    )
+                elif better:
+                    improvements.append({"metric": ref, "delta_pct": delta_pct})
+            stage_out[metric] = entry
+        if stage_out:
+            report[stage] = stage_out
+    result = {
+        "mode": "compare",
+        "a": args[0],
+        "b": args[1],
+        "tolerance_pct": tolerance,
+        "stages_compared": sorted(report),
+        "stages_only_a": sorted(set(sa) - set(sb)),
+        "stages_only_b": sorted(set(sb) - set(sa)),
+        "regressions": regressions,
+        "improvements": improvements,
+        "stages": report,
+        "ok": not regressions,
+    }
+    print(json.dumps(result))
+    return 1 if regressions else 0
+
+
 def main() -> int:
     if "--worker" in sys.argv:
         return worker_main()
     if "--sharded-worker" in sys.argv:
         return sharded_worker_main()
+    if "--compare" in sys.argv:
+        return compare_main(sys.argv)
 
     total = float(os.environ.get("BENCH_DEADLINE", "480"))
     deadline = time.monotonic() + total

@@ -30,6 +30,7 @@ from bioengine_tpu.apps.manifest import AppManifest, load_manifest
 from bioengine_tpu.rpc.schema import is_schema_method
 from bioengine_tpu.serving.controller import DeploymentSpec
 from bioengine_tpu.serving.scheduler import SchedulingConfig
+from bioengine_tpu.serving.slo import SLOConfig
 from bioengine_tpu.utils.logger import create_logger
 
 # env var override mirroring the reference's local-artifact escape hatch
@@ -358,6 +359,7 @@ class AppBuilder:
             # predictive autoscaling)
             batching = dict(cfg.get("batching") or {})
             scheduling_cfg = cfg.get("scheduling")
+            slo_cfg = cfg.get("slo")
             try:
                 spec_max_batch = (
                     int(batching["max_batch"])
@@ -374,11 +376,14 @@ class AppBuilder:
                     if scheduling_cfg
                     else None
                 )
+                slo = (
+                    SLOConfig.from_config(dict(slo_cfg)) if slo_cfg else None
+                )
             except (TypeError, ValueError) as e:
                 # every config mistake on this path fails TYPED with the
                 # deployment named — never a raw traceback
                 raise AppBuildError(
-                    f"invalid batching/scheduling config for deployment "
+                    f"invalid batching/scheduling/slo config for deployment "
                     f"'{ref.file_stem}': {e}"
                 ) from e
             specs.append(
@@ -394,6 +399,7 @@ class AppBuilder:
                     max_batch=spec_max_batch,
                     max_wait_ms=spec_max_wait_ms,
                     scheduling=scheduling,
+                    slo=slo,
                     remote_payload={
                         **base_payload,
                         "deployment": ref.file_stem,

@@ -28,7 +28,10 @@ _DEPLOYMENT_RE = re.compile(r"^([A-Za-z_][\w\-/]*):([A-Za-z_]\w*)$")
 # ``batching`` feeds the replica's ContinuousBatcher (injected as
 # bioengine_batch_config); ``scheduling`` opts the deployment into the
 # controller's global scheduler (key set validated in depth by
-# serving.scheduler.SchedulingConfig.from_config at build time).
+# serving.scheduler.SchedulingConfig.from_config at build time);
+# ``slo`` declares the deployment's service objectives (validated in
+# depth by serving.slo.SLOConfig.from_config at build time — latency
+# objective + percentile, availability target, window).
 _BATCHING_KEYS = {"max_batch", "max_wait_ms"}
 
 
@@ -110,6 +113,12 @@ def validate_manifest(data: dict[str, Any]) -> AppManifest:
             raise ManifestError(
                 f"deployment_config.{dep_name}.scheduling must be a "
                 f"mapping, got {type(scheduling).__name__}"
+            )
+        slo = cfg.get("slo")
+        if slo is not None and not isinstance(slo, dict):
+            raise ManifestError(
+                f"deployment_config.{dep_name}.slo must be a "
+                f"mapping, got {type(slo).__name__}"
             )
     return AppManifest(
         name=str(data["name"]),

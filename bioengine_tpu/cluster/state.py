@@ -59,6 +59,10 @@ class HostRecord:
     chips_in_use: dict[int, str] = field(default_factory=dict)
     alive: bool = True
     worker_tag: Optional[str] = None       # provisioner job tag, if any
+    # host wall clock minus controller wall clock, RTT-midpoint estimate
+    # measured by the host at join/rejoin — merged incident timelines
+    # and telemetry attribution de-skew with it
+    clock_skew_s: float = 0.0
 
     @property
     def n_chips(self) -> int:
@@ -124,6 +128,7 @@ class ClusterState:
                     "n_chips": h.n_chips,
                     "n_chips_free": len(h.free_chip_ids()),
                     "worker_tag": h.worker_tag,
+                    "clock_skew_s": h.clock_skew_s,
                 }
                 for h in self.hosts.values()
             },
@@ -202,6 +207,7 @@ class ClusterState:
         service_id: str,
         topology: dict,
         worker_tag: Optional[str] = None,
+        clock_skew_s: float = 0.0,
     ) -> None:
         self.hosts[host_id] = HostRecord(
             host_id=host_id,
@@ -209,6 +215,7 @@ class ClusterState:
             topology=dict(topology),
             registered_at=time.time(),
             worker_tag=worker_tag,
+            clock_skew_s=float(clock_skew_s or 0.0),
         )
 
     def mark_host_dead(self, host_id: str) -> list[str]:

@@ -77,10 +77,16 @@ class ServerConnection:
         # capabilities declared at handshake; [] forces pure-legacy
         # framing in BOTH directions (bench baseline, interop tests)
         self.protocols = (
-            [protocol.PROTO_OOB1, protocol.PROTO_TRACE1]
+            [protocol.PROTO_OOB1, protocol.PROTO_TRACE1, protocol.PROTO_TELEM1]
             if protocols is None
             else list(protocols)
         )
+        # what the SERVER advertised at the last welcome (telem1 and
+        # future server-side capabilities gate on this, see
+        # peer_supports) and the last measured wall-clock offset to it
+        self.peer_protocols: list[str] = []
+        self.clock_offset_s: Optional[float] = None
+        self.clock_offset_rtt_s: Optional[float] = None
         self.auto_reconnect = auto_reconnect
         self.reconnect_max_backoff_s = reconnect_max_backoff_s
         # connection-lifecycle hooks (sync or async callables): fired on
@@ -130,6 +136,7 @@ class ServerConnection:
         self.client_id = welcome["client_id"]
         self.workspace = welcome["workspace"]
         self.user_id = welcome["user_id"]
+        self.peer_protocols = list(welcome.get("protocols", []))
         self.codec.oob = protocol.PROTO_OOB1 in self.protocols and (
             protocol.PROTO_OOB1 in welcome.get("protocols", [])
         )
@@ -519,6 +526,42 @@ class ServerConnection:
         self._pending["__ping__"] = fut
         await self._send_msg({"t": protocol.PING})
         return await asyncio.wait_for(fut, 10.0)
+
+    def peer_supports(self, capability: str) -> bool:
+        """Did the server advertise ``capability`` at the welcome?
+        (Client-declared capabilities gate what WE put on the wire;
+        this gates what we may ASK of the server — e.g. ``telem1``'s
+        push_telemetry verb.)"""
+        return capability in self.peer_protocols
+
+    async def measure_clock_offset(self, samples: int = 3) -> dict:
+        """Estimate this process's wall-clock offset to the server via
+        RTT-midpoint pings (NTP's core idea): the server's PONG
+        timestamp is assumed taken halfway through the round trip, so
+        ``offset = server_ts - (t_send + t_recv)/2``. The sample with
+        the smallest RTT wins — queueing delay only ever inflates RTT,
+        and the least-delayed exchange is closest to the symmetric
+        ideal. Stored on the connection (``clock_offset_s``, positive =
+        the server's clock is ahead of ours) and refreshed by callers
+        on reconnect; merged incident timelines use it to de-skew
+        multi-host event ordering (utils/flight.merge_records)."""
+        import time as _time
+
+        best: Optional[tuple[float, float]] = None  # (rtt, offset)
+        for _ in range(max(1, samples)):
+            t0 = _time.time()
+            server_ts = await self.ping()
+            t1 = _time.time()
+            rtt = t1 - t0
+            offset = float(server_ts) - (t0 + t1) / 2.0
+            if best is None or rtt < best[0]:
+                best = (rtt, offset)
+        self.clock_offset_rtt_s, self.clock_offset_s = best
+        return {
+            "offset_s": round(best[1], 6),
+            "rtt_s": round(best[0], 6),
+            "samples": samples,
+        }
 
 
 async def connect_to_server(config: dict[str, Any]) -> ServerConnection:

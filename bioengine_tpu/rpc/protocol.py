@@ -68,6 +68,7 @@ OOB_MAGIC = b"BEF1"            # out-of-band scatter-gather frame
 CHUNK_MAGIC = b"BEC1"          # one chunk of an oversized frame
 PROTO_OOB1 = "oob1"            # negotiated capability name
 PROTO_TRACE1 = "trace1"        # request-trace fields on CALL/RESULT
+PROTO_TELEM1 = "telem1"        # push-telemetry verbs on the serve-router
 
 EXT_NDARRAY = 1                # legacy inline array (double-packed)
 EXT_EXCEPTION = 2

@@ -613,7 +613,11 @@ class RpcServer:
             "client_id": client_id,
             "workspace": info.workspace,
             "user_id": info.user_id,
-            "protocols": [protocol.PROTO_OOB1, protocol.PROTO_TRACE1],
+            "protocols": [
+                protocol.PROTO_OOB1,
+                protocol.PROTO_TRACE1,
+                protocol.PROTO_TELEM1,
+            ],
         }
         if codec.oob and self._shm_store is not None:
             # same-host probe: the client must read this nonce OUT OF

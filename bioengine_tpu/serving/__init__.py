@@ -20,6 +20,7 @@ from bioengine_tpu.serving.scheduler import (
     LoadPredictor,
     SchedulingConfig,
 )
+from bioengine_tpu.serving.slo import SLOConfig, SLOEngine
 
 __all__ = [
     "AdmissionRejectedError",
@@ -38,5 +39,7 @@ __all__ = [
     "RequestOptions",
     "RetryableTransportError",
     "SchedulingConfig",
+    "SLOConfig",
+    "SLOEngine",
     "ServeController",
 ]

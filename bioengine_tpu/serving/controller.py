@@ -21,7 +21,7 @@ import asyncio
 import itertools
 import os
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
@@ -49,7 +49,13 @@ from bioengine_tpu.serving.replica import (
     Replica,
     ReplicaState,
 )
+from bioengine_tpu.serving.slo import SLOConfig, SLOEngine
 from bioengine_tpu.utils import flight, metrics, tracing
+from bioengine_tpu.utils.telemetry import (
+    SERIES_NAMES,
+    RegistrySampler,
+    TelemetryStore,
+)
 from bioengine_tpu.utils.backoff import full_jitter_delay
 from bioengine_tpu.utils.logger import create_logger
 
@@ -220,6 +226,10 @@ class DeploymentSpec:
     # control + predictive autoscaling); None keeps the per-request
     # router path
     scheduling: Optional[SchedulingConfig] = None
+    # per-deployment service objectives (manifest slo: block) — the
+    # controller's SLO engine evaluates burn rates against these; None
+    # means untracked (no alerting, no budget accounting)
+    slo: Optional[SLOConfig] = None
 
     def batch_config(self) -> Optional[dict]:
         if self.max_batch is None and self.max_wait_ms is None:
@@ -622,6 +632,22 @@ class ServeController:
         self._replicas_changed = asyncio.Event()
         self._rpc_server = None            # set by attach_rpc (multi-host)
         self._router_admins: list[str] = []
+        # telemetry history + SLO engine (the proactive half of the
+        # observability plane): the store aggregates this process's
+        # registry deltas plus telem1 pushes from worker hosts; the
+        # engine evaluates burn rates on the same tick. Page-severity
+        # firings auto-capture an incident bundle (rate-limited).
+        self.telemetry = TelemetryStore()
+        self._telem_sampler = RegistrySampler()
+        self.slo = SLOEngine(
+            self.telemetry, on_page=self._slo_page_hook, logger=self.logger
+        )
+        self.telemetry_interval_s = float(
+            os.environ.get("BIOENGINE_TELEM_PUSH_S", "10")
+        )
+        self._telemetry_task: Optional[asyncio.Task] = None
+        self.slo_bundles: deque = deque(maxlen=4)   # auto-captured artifacts
+        self._slo_bundle_last: dict[tuple[str, str], float] = {}
         _CONTROLLERS.add(self)             # scrape-time serving gauges
 
     # ---- multi-host control plane -------------------------------------------
@@ -661,11 +687,13 @@ class ServeController:
             topology,
             worker_tag=None,
             replicas=None,
+            clock_skew_s=0.0,
             context=None,
         ):
             check_permissions(context, self._router_admins, "register_host")
             self.cluster_state.register_host(
-                host_id, service_id, topology, worker_tag
+                host_id, service_id, topology, worker_tag,
+                clock_skew_s=clock_skew_s,
             )
             # reconcile a REJOINING host's still-warm replicas: each one
             # the controller still routes to this host is re-adopted
@@ -706,6 +734,37 @@ class ServeController:
             orphans = self.cluster_state.mark_host_dead(host_id)
             return {"host_id": host_id, "orphaned_replicas": orphans}
 
+        def push_telemetry(host_id, snapshot, context=None):
+            # capability telem1: worker hosts push periodic registry
+            # deltas here. A push from THIS process (the in-process
+            # multi-host harness shares one registry, which the local
+            # sampler already covers) is dropped by source identity —
+            # the same dedup rule flight.merge_records applies.
+            check_permissions(context, self._router_admins, "push_telemetry")
+            if (
+                isinstance(snapshot, dict)
+                and snapshot.get("source_id") == self._telem_sampler.source_id
+            ):
+                return {"host_id": host_id, "accepted": 0, "deduped": True}
+            # de-skew: captured_at is the PUSHER's wall clock — shift it
+            # onto the controller's timeline with the offset the host
+            # measured at its handshake, or a fast host's future-dated
+            # buckets would swallow every on-time sample behind them
+            record = self.cluster_state.hosts.get(host_id)
+            if (
+                record is not None
+                and record.clock_skew_s
+                and isinstance(snapshot, dict)
+                and snapshot.get("captured_at") is not None
+            ):
+                snapshot = {
+                    **snapshot,
+                    "captured_at": float(snapshot["captured_at"])
+                    - record.clock_skew_s,
+                }
+            accepted = self.telemetry.ingest(snapshot, host_id=host_id)
+            return {"host_id": host_id, "accepted": accepted}
+
         server.register_local_service(
             {
                 "id": "serve-router",
@@ -718,6 +777,7 @@ class ServeController:
                 "route_call": route_call,
                 "register_host": register_host,
                 "deregister_host": deregister_host,
+                "push_telemetry": push_telemetry,
             }
         )
 
@@ -741,13 +801,39 @@ class ServeController:
     async def start(self) -> None:
         if self._health_task is None:
             self._health_task = asyncio.create_task(self._health_loop())
+        if self._telemetry_task is None:
+            self._telemetry_task = asyncio.create_task(self._telemetry_loop())
 
     async def stop(self) -> None:
         if self._health_task:
             self._health_task.cancel()
             self._health_task = None
+        if self._telemetry_task:
+            self._telemetry_task.cancel()
+            self._telemetry_task = None
         for app_id in list(self.apps):
             await self.undeploy(app_id)
+
+    async def _telemetry_loop(self) -> None:
+        while True:
+            try:
+                await asyncio.sleep(self.telemetry_interval_s)
+                self.telemetry_tick()
+            except asyncio.CancelledError:
+                return
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                self.logger.error(f"telemetry tick error: {e}")
+
+    def telemetry_tick(self) -> None:
+        """One observation pass: fold this process's registry deltas
+        into the telemetry store, then run the SLO/anomaly evaluation.
+        The periodic loop calls this; tests and the CI dryrun drive it
+        directly for determinism."""
+        snapshot = self._telem_sampler.sample()
+        if snapshot:
+            self.telemetry.ingest(snapshot, host_id="controller")
+        if self.slo.deployments():
+            self.slo.evaluate()
 
     # ---- deploy / undeploy --------------------------------------------------
 
@@ -768,16 +854,24 @@ class ServeController:
             for spec in specs:
                 app.replicas[spec.name] = []
                 if spec.scheduling is not None and spec.scheduling.enabled:
-                    self._schedulers[(app_id, spec.name)] = (
-                        DeploymentScheduler(
-                            self,
-                            app_id,
-                            spec.name,
-                            spec,
-                            spec.scheduling,
-                            scorer=self.scorer_factory(),
-                        )
+                    scheduler = DeploymentScheduler(
+                        self,
+                        app_id,
+                        spec.name,
+                        spec,
+                        spec.scheduling,
+                        scorer=self.scorer_factory(),
                     )
+                    self._schedulers[(app_id, spec.name)] = scheduler
+                    if spec.scheduling.slo_pressure and spec.slo is not None:
+                        # close the loop: the predictive autoscaler may
+                        # consume budget burn as an up-pressure signal
+                        # (opt-in — scheduling.slo_pressure)
+                        scheduler.pressure_fn = (
+                            lambda a=app_id, d=spec.name: self.slo.burn_pressure(a, d)
+                        )
+                if spec.slo is not None:
+                    self.slo.register(app_id, spec.name, spec.slo)
                 for _ in range(spec.num_replicas):
                     await self._add_replica(app, spec)
             app.status = "RUNNING"
@@ -786,6 +880,7 @@ class ServeController:
             # Roll back partial state: stop started replicas and release
             # their chip leases so a failed deploy leaks nothing.
             app.status = "DEPLOY_FAILED"
+            self.slo.unregister(app_id)
             for spec in specs:
                 sched = self._schedulers.pop((app_id, spec.name), None)
                 if sched is not None:
@@ -978,6 +1073,11 @@ class ServeController:
         for name in app.specs:
             self._queue_depth.pop((app_id, name), None)
             self._rr_counters.pop((app_id, name), None)
+        # observability-state sweep: a dead deployment must not keep
+        # alerting or report history as live (get_telemetry races with
+        # undeploy by design — see tests/test_slo.py churn test)
+        self.slo.unregister(app_id)
+        self.telemetry.sweep(app_id)
         app.status = "STOPPED"
         self.logger.info(f"app '{app_id}' undeployed")
 
@@ -1416,6 +1516,137 @@ class ServeController:
             },
         }
 
+    # ---- telemetry / SLO surfaces -------------------------------------------
+
+    def get_telemetry(
+        self,
+        series: Any = None,
+        app: Optional[str] = None,
+        deployment: Optional[str] = None,
+        since: Optional[float] = None,
+        resolution: Optional[float] = None,
+    ) -> dict:
+        """Reconstructed per-deployment series from the telemetry
+        store (rates, latency quantiles from merged buckets, queue
+        depth, chip-seconds, shed counts). ``series`` is one name, a
+        list, or None for all; ``resolution`` picks a ring (seconds,
+        next-coarser match), ``since`` a wall-clock cursor. Only LIVE
+        history is reported — undeploy sweeps a deployment's series."""
+        if isinstance(series, str):
+            names = [series]
+        else:
+            names = list(series) if series else list(SERIES_NAMES)
+        unknown = sorted(set(names) - set(SERIES_NAMES))
+        if unknown:
+            raise ValueError(
+                f"unknown telemetry series {unknown} "
+                f"(available: {list(SERIES_NAMES)})"
+            )
+        store = self.telemetry
+        out: dict[str, Any] = {
+            "generated_at": time.time(),
+            "store": store.describe(),
+            "deployments": {},
+        }
+        for a, d in store.keys():
+            if app is not None and a != app:
+                continue
+            if deployment is not None and d != deployment:
+                continue
+            out["deployments"][f"{a}/{d}"] = {
+                name: store.series(
+                    a, d, name, since=since, resolution=resolution
+                )
+                for name in names
+            }
+        return out
+
+    def get_slo_status(self) -> dict:
+        """Burn rates, budget remaining, and alert state per tracked
+        deployment, plus metadata of auto-captured incident bundles —
+        JSON-able (this is the ``get_slo_status`` verb body and the
+        ``bioengine slo status`` CLI feed)."""
+        status = self.slo.status()
+        status["auto_bundles"] = [
+            {
+                "generated_at": b.get("generated_at"),
+                "alert": b.get("slo_alert"),
+                "events": len(b.get("events", [])),
+            }
+            for b in self.slo_bundles
+        ]
+        return status
+
+    def _slo_page_hook(self, alert: dict) -> None:
+        """A page-severity SLO firing: snapshot the flight ring NOW
+        (evidence survives even if the bundle task is starved), then
+        capture a full cross-host incident bundle in the background —
+        rate-limited per deployment so a flapping alert cannot DoS the
+        hosts with bundle gathering."""
+        key = (alert.get("app", ""), alert.get("deployment", ""))
+        interval = float(
+            os.environ.get("BIOENGINE_SLO_BUNDLE_INTERVAL_S", "300")
+        )
+        now = time.monotonic()
+        last = self._slo_bundle_last.get(key)
+        if last is not None and now - last < interval:
+            return
+        self._slo_bundle_last[key] = now
+        flight.dump(
+            "slo_page",
+            app=alert.get("app"),
+            deployment=alert.get("deployment"),
+            objective=alert.get("objective"),
+        )
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            return  # no loop (sync unit test) — the dump above is the artifact
+        from bioengine_tpu.utils.tasks import spawn_supervised
+
+        spawn_supervised(
+            self._capture_slo_bundle(alert),
+            name="slo-auto-bundle",
+            logger=self.logger,
+        )
+
+    async def _capture_slo_bundle(self, alert: dict) -> None:
+        try:
+            bundle = await self.debug_bundle()
+        except Exception as e:  # noqa: BLE001 — bundling never breaks serving
+            self.logger.error(f"slo auto-bundle failed: {e}")
+            return
+        bundle["slo_alert"] = alert
+        self.slo_bundles.append(bundle)
+        flight.record(
+            "slo.bundle",
+            app=alert.get("app"),
+            deployment=alert.get("deployment"),
+            objective=alert.get("objective"),
+            events=len(bundle.get("events", [])),
+        )
+        target_dir = os.environ.get("BIOENGINE_FLIGHT_DIR")
+        if target_dir:
+            import json as _json
+            from pathlib import Path as _Path
+
+            def _write() -> None:
+                try:
+                    path = _Path(target_dir).expanduser()
+                    path.mkdir(parents=True, exist_ok=True)
+                    stamp = time.strftime("%Y%m%d-%H%M%S")
+                    name = (
+                        f"slo-bundle-{stamp}-{alert.get('app')}"
+                        f"-{alert.get('deployment')}.json"
+                    )
+                    (path / name).write_text(
+                        _json.dumps(bundle, indent=2, default=str)
+                    )
+                except OSError as e:
+                    self.logger.warning(f"slo bundle not persisted: {e}")
+
+            await asyncio.get_running_loop().run_in_executor(None, _write)
+
     async def debug_bundle(
         self,
         event_limit: int = 2000,
@@ -1457,12 +1688,19 @@ class ServeController:
                         rpc_timeout=host_timeout_s,
                     ),
                 )
+                # skew: prefer the host's own latest handshake estimate
+                # (stamped on its record), fall back to what it reported
+                # at registration — either way the merged timeline below
+                # is corrected onto the controller's clock
+                if "clock_skew_s" not in rec:
+                    rec["clock_skew_s"] = host.clock_skew_s
                 records.append(rec)
                 hosts_out[host.host_id] = {
                     "reachable": True,
                     "recorder": rec.get("recorder"),
                     "flight_events": len(rec.get("events", []) or []),
                     "dumps": rec.get("dumps", []),
+                    "clock_skew_s": rec.get("clock_skew_s", 0.0),
                     "metrics": met,
                     "describe": desc,
                 }
@@ -1491,6 +1729,8 @@ class ServeController:
                 max_spans=max_spans, include_open=True
             ),
             "metrics": metrics.collect(),
+            "slo": self.slo.status(),
+            "telemetry": self.telemetry.describe(),
             "cluster": self.cluster_state.snapshot(),
             "apps": {
                 app_id: self.get_app_status(app_id)

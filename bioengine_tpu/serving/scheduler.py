@@ -213,6 +213,10 @@ class SchedulingConfig:
     target_wait_s: float = 1.0          # predictive scale-up threshold
     scale_down_ticks: int = 3           # hysteresis before scale-down
     ewma_alpha: float = 0.2
+    # consume the SLO engine's error-budget burn as an autoscale
+    # up-pressure signal (needs a manifest slo: block; off by default —
+    # the loop only closes where an operator asked it to)
+    slo_pressure: bool = False
 
     @classmethod
     def from_config(cls, cfg: dict) -> "SchedulingConfig":
@@ -254,6 +258,8 @@ class SchedulingConfig:
             out.scale_down_ticks = max(1, int(cfg["scale_down_ticks"]))
         if "ewma_alpha" in cfg:
             out.ewma_alpha = min(1.0, max(0.01, float(cfg["ewma_alpha"])))
+        if "slo_pressure" in cfg:
+            out.slo_pressure = bool(cfg["slo_pressure"])
         return out
 
 
@@ -497,6 +503,13 @@ class DeploymentScheduler:
         }
         self._infeasible_streak = 0
         self._warned_priorities: set = set()
+        # SLO burn-rate pressure hook (the pluggable half of "close the
+        # loop"): a zero-arg callable returning the deployment's current
+        # burn normalized to the page threshold. None (the default)
+        # keeps scaling purely queue-projection driven; the controller
+        # wires it only when scheduling.slo_pressure is on AND the
+        # deployment carries a manifest slo: block.
+        self.pressure_fn: Optional[Callable[[], float]] = None
         self._m_admitted: dict[str, Any] = {}
         self._m_wait: dict[str, Any] = {}
         self._m_batch = SCHED_BATCH_SIZE.labels(app_id, deployment)
@@ -1186,13 +1199,29 @@ class DeploymentScheduler:
             self.spec.target_load,
             self.cfg.scale_down_ticks,
         )
+        trigger = "tick"
+        if self.pressure_fn is not None:
+            try:
+                pressure = float(self.pressure_fn())
+            except Exception:  # noqa: BLE001 — a hook bug must not stop scaling
+                pressure = 0.0
+            proj["slo_pressure"] = round(pressure, 3)
+            if pressure >= 1.0 and decision != "up":
+                # the deployment is burning its error budget at page
+                # rate: capacity is the one lever the controller holds,
+                # whatever the queue projection says (latency burn with
+                # shallow queues = slow replicas, not idle ones). ONE
+                # event, attributed to the burn — the projection below
+                # is the one that said hold.
+                decision = "up"
+                trigger = "slo_burn"
         if decision != "hold":
             flight.record(
                 "scale.predict",
                 app=self.app_id,
                 deployment=self.deployment,
                 direction=decision,
-                trigger="tick",
+                trigger=trigger,
                 **{
                     k: proj[k]
                     for k in (
@@ -1201,6 +1230,7 @@ class DeploymentScheduler:
                         "service_s",
                         "utilization",
                         "queue_depth",
+                        *(("slo_pressure",) if "slo_pressure" in proj else ()),
                     )
                 },
             )

@@ -31,6 +31,10 @@ points the serving/rpc/runtime layers already own:
 ``program.evict``           compiled program evicted from the cache
 ``fault.hit``               a chaos fault point actually triggered
 ``flight.dump``             a dump snapshot was taken (reason)
+``slo.pending/firing/resolved``  SLO alert lifecycle (page firing
+                            auto-dumps + auto-captures a debug bundle)
+``slo.bundle``              an SLO auto-bundle was captured
+``anomaly.detect``          a telemetry-series excursion (EWMA residual)
 ==========================  ================================================
 
 Design constraints, in order:
@@ -249,10 +253,20 @@ def merge_records(records: Iterable[dict]) -> list[dict]:
     ``(recorder, seq)`` so gathering one process through two surfaces
     (or an in-process multi-host test harness sharing a single ring)
     never double-reports; ordering is wall-clock with
-    ``(recorder, seq)`` as the stable tie-break."""
+    ``(recorder, seq)`` as the stable tie-break.
+
+    Clock-skew correction: a record carrying ``clock_skew_s`` (the
+    producing host's wall clock minus the controller's, estimated at
+    the RPC handshake by RTT-midpoint and refreshed on reconnect —
+    worker_host.py) gets every event's ``ts`` shifted onto the
+    controller's timeline; the raw stamp is preserved as ``ts_raw``
+    and the applied skew annotated per event, so a host whose clock
+    runs 5 s fast no longer scrambles the incident ordering."""
     seen: set[tuple] = set()
     out: list[dict] = []
     for rec in records:
+        skew = rec.get("clock_skew_s")
+        skew = float(skew) if skew else 0.0
         for e in rec.get("events", []) or []:
             if not isinstance(e, dict):
                 continue
@@ -260,6 +274,13 @@ def merge_records(records: Iterable[dict]) -> list[dict]:
             if key in seen:
                 continue
             seen.add(key)
+            if skew and "ts" in e:
+                e = {
+                    **e,
+                    "ts": e["ts"] - skew,
+                    "ts_raw": e["ts"],
+                    "clock_skew_s": round(skew, 6),
+                }
             out.append(e)
     out.sort(key=lambda e: (e.get("ts", 0.0), e.get("recorder", ""), e.get("seq", 0)))
     return out

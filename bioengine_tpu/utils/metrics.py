@@ -145,8 +145,34 @@ class HistogramChild:
         return math.inf
 
 
+OVERFLOW_LABEL = "__overflow__"
+
+_MAX_CHILDREN: Optional[int] = None
+
+
+def _max_children() -> int:
+    """Per-family child cap (``BIOENGINE_METRICS_MAX_LABELS``, default
+    1000). Read once — labels() can sit on warm request paths."""
+    global _MAX_CHILDREN
+    if _MAX_CHILDREN is None:
+        import os
+
+        _MAX_CHILDREN = int(
+            os.environ.get("BIOENGINE_METRICS_MAX_LABELS", "1000")
+        )
+    return _MAX_CHILDREN
+
+
 class _Family:
-    """A named metric family with a fixed label schema."""
+    """A named metric family with a fixed label schema.
+
+    Cardinality guard: a hostile or buggy caller feeding unbounded
+    label values (e.g. arbitrary ``method`` strings) would otherwise
+    grow the child map — and the process — without bound. At
+    ``BIOENGINE_METRICS_MAX_LABELS`` distinct children the family
+    folds every NEW label set into one ``__overflow__`` child, warns
+    once, and counts the drops in ``metrics_dropped_labels_total`` so
+    the truncation is visible on the same scrape it protects."""
 
     kind = "untyped"
 
@@ -156,6 +182,7 @@ class _Family:
         self.labelnames = tuple(labelnames)
         self._children: dict[tuple, Any] = {}
         self._lock = threading.Lock()
+        self._overflow_warned = False
 
     def _make_child(self):
         raise NotImplementedError
@@ -169,7 +196,36 @@ class _Family:
         child = self._children.get(key)
         if child is None:
             with self._lock:
-                child = self._children.setdefault(key, self._make_child())
+                child = self._children.get(key)
+                if child is None:
+                    if (
+                        self.labelnames
+                        and len(self._children) >= _max_children()
+                    ):
+                        return self._overflow_child_locked()
+                    child = self._children[key] = self._make_child()
+        return child
+
+    def _overflow_child_locked(self):
+        """Called under self._lock: the shared sink child for label
+        sets past the cap."""
+        okey = (OVERFLOW_LABEL,) * len(self.labelnames)
+        child = self._children.get(okey)
+        if child is None:
+            child = self._children[okey] = self._make_child()
+        if not self._overflow_warned:
+            self._overflow_warned = True
+            _collector_logger.warning(
+                f"metric family '{self.name}' hit the label-cardinality "
+                f"cap ({_max_children()}); folding new label sets into "
+                f"'{OVERFLOW_LABEL}' (raise BIOENGINE_METRICS_MAX_LABELS "
+                f"if this cardinality is intentional)"
+            )
+        # DROPPED_LABELS is a plain family whose own cardinality is
+        # bounded by the number of registered families; never recurse
+        # into ourselves if the guard family itself ever hits the cap
+        if self.name != "metrics_dropped_labels_total":
+            DROPPED_LABELS.labels(self.name).inc()
         return child
 
     def items(self) -> list[tuple[tuple, Any]]:
@@ -404,6 +460,15 @@ def _line(name: str, labels: dict, value: float) -> str:
 
 REGISTRY = MetricsRegistry()
 
+# the cardinality guard's visible half: how many label sets each family
+# folded into its __overflow__ child (labelled by family, so its own
+# cardinality is bounded by the number of registered families)
+DROPPED_LABELS = REGISTRY.counter(
+    "metrics_dropped_labels_total",
+    "label sets folded into __overflow__ by the cardinality guard",
+    ("family",),
+)
+
 _ENABLED: Optional[bool] = None
 
 
@@ -422,8 +487,9 @@ def metrics_enabled() -> bool:
 
 
 def reset_env_cache() -> None:
-    global _ENABLED
+    global _ENABLED, _MAX_CHILDREN
     _ENABLED = None
+    _MAX_CHILDREN = None
 
 
 def counter(name: str, help: str = "", labelnames: Sequence[str] = ()) -> Counter:

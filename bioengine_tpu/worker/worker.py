@@ -324,6 +324,8 @@ class BioEngineWorker:
             "memory_profile": self.memory_profile,
             "get_traces": self.get_traces,
             "get_metrics": self.get_metrics,
+            "get_telemetry": self.get_telemetry,
+            "get_slo_status": self.get_slo_status,
             "get_flight_record": self.get_flight_record,
             "debug_bundle": self.debug_bundle,
             **self.code_executor.service_methods(),
@@ -602,6 +604,40 @@ class BioEngineWorker:
         if prometheus:
             return metrics.render_prometheus()
         return metrics.collect()
+
+    def get_telemetry(
+        self,
+        series: Any = None,
+        app: Optional[str] = None,
+        deployment: Optional[str] = None,
+        since: Optional[float] = None,
+        resolution: Optional[float] = None,
+        context: Optional[dict] = None,
+    ) -> dict:
+        """Per-deployment telemetry HISTORY from the controller's
+        multi-resolution store (request/error rates, latency quantiles
+        reconstructed from merged histogram buckets, queue depth,
+        chip-seconds, shed counts) — what the live registry forgets,
+        `bioengine top` renders, and the SLO engine evaluates.
+        Admin-only."""
+        check_permissions(context, self.admin_users, "get_telemetry")
+        assert self.controller is not None
+        return self.controller.get_telemetry(
+            series=series,
+            app=app,
+            deployment=deployment,
+            since=since,
+            resolution=resolution,
+        )
+
+    def get_slo_status(self, context: Optional[dict] = None) -> dict:
+        """Burn rates, error-budget remaining, and alert state for
+        every deployment carrying a manifest ``slo:`` block, plus
+        auto-captured incident-bundle metadata (the ``bioengine slo
+        status`` CLI feed). Admin-only."""
+        check_permissions(context, self.admin_users, "get_slo_status")
+        assert self.controller is not None
+        return self.controller.get_slo_status()
 
     def memory_profile(self, context: Optional[dict] = None) -> dict:
         """Device-memory snapshot (pprof-format bytes, base64) plus the

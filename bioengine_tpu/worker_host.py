@@ -46,6 +46,7 @@ import uuid
 from pathlib import Path
 from typing import Any, Optional
 
+from bioengine_tpu.rpc import protocol
 from bioengine_tpu.rpc.client import ServerConnection, connect_to_server
 from bioengine_tpu.testing import faults
 from bioengine_tpu.utils import flight
@@ -111,6 +112,12 @@ class WorkerHost:
         self.rejoin = rejoin
         self._stop_event = asyncio.Event()
         self._conn_lost = asyncio.Event()
+        # wall-clock skew to the controller (this host minus the
+        # controller), RTT-midpoint estimate refreshed on every
+        # join/rejoin — rides register_host and every flight record so
+        # merged incident timelines order correctly
+        self.clock_skew_s = 0.0
+        self._telemetry_task: Optional[asyncio.Task] = None
 
     # ---- lifecycle ----------------------------------------------------------
 
@@ -164,15 +171,36 @@ class WorkerHost:
             logger=self.logger,
         )
         joined = await self._register_host()
+        # push-telemetry (capability telem1, same negotiation pattern as
+        # oob1/trace1): periodic registry-delta snapshots to the
+        # controller's store. A legacy control plane that never
+        # advertised telem1 keeps working scrape-only.
+        if self.connection.peer_supports(protocol.PROTO_TELEM1):
+            self._telemetry_task = spawn_supervised(
+                self._telemetry_loop(),
+                name="telemetry-push",
+                logger=self.logger,
+            )
         self.logger.info(
             f"joined cluster as '{self.host_id}' "
             f"({self.topology.n_chips} chips): {joined}"
         )
         return joined
 
+    async def _measure_clock_skew(self) -> None:
+        """RTT-midpoint wall-clock offset to the controller; failure
+        keeps the previous estimate (never blocks a join)."""
+        try:
+            probe = await self.connection.measure_clock_offset()
+            # offset = controller minus us; skew = us minus controller
+            self.clock_skew_s = -probe["offset_s"]
+        except Exception as e:  # noqa: BLE001 — a join must not die on a probe
+            self.logger.debug(f"clock-skew probe failed (tolerated): {e}")
+
     async def _register_host(self) -> dict:
         # NB: positional — kwargs named service_id/method would collide
         # with ServerConnection.call's own parameters
+        await self._measure_clock_skew()
         return await self.connection.call(
             "serve-router",
             "register_host",
@@ -181,7 +209,37 @@ class WorkerHost:
             self.topology.as_dict(),
             self.worker_tag,
             self._replica_inventory(),
+            self.clock_skew_s,
         )
+
+    async def _telemetry_loop(self) -> None:
+        """Push periodic metric-delta snapshots (utils/telemetry.py
+        RegistrySampler over THIS process's registry: replica latency
+        histograms, chip-seconds) to the controller's telemetry store.
+        A push failure is tolerated — the next interval retries, and a
+        reconnect resumes pushing against the healed session."""
+        from bioengine_tpu.utils.telemetry import RegistrySampler
+
+        interval = float(os.environ.get("BIOENGINE_TELEM_PUSH_S", "10"))
+        sampler = RegistrySampler()
+        sampler.sample()  # establish the delta baseline
+        while not self._stop_event.is_set():
+            await asyncio.sleep(interval)
+            if self.connection is None or not self.connection.connected:
+                continue
+            try:
+                snapshot = sampler.sample()
+                if snapshot:
+                    await self.connection.call(
+                        "serve-router",
+                        "push_telemetry",
+                        self.host_id,
+                        snapshot,
+                    )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — telemetry is best-effort
+                self.logger.debug(f"telemetry push failed (tolerated): {e}")
 
     def _replica_inventory(self) -> list[dict]:
         return [
@@ -254,6 +312,10 @@ class WorkerHost:
                 )
 
     async def stop(self) -> None:
+        self._stop_event.set()
+        if self._telemetry_task is not None:
+            self._telemetry_task.cancel()
+            self._telemetry_task = None
         if getattr(self, "_loop_lag_task", None):
             self._loop_lag_task.cancel()
             self._loop_lag_task = None
@@ -458,6 +520,9 @@ class WorkerHost:
         admin callers only."""
         record = flight.get_record(limit=limit, since=since)
         record["host_id"] = self.host_id
+        # measured at the last join/rejoin handshake: merge_records
+        # shifts these events onto the controller's timeline with it
+        record["clock_skew_s"] = round(self.clock_skew_s, 6)
         return record
 
     # ---- on-demand device profiling (routed here by the controller so

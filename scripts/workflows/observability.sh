@@ -1,9 +1,11 @@
 #!/usr/bin/env bash
 # Observability gate: tracing/metrics plane tests, flight-recorder +
-# incident-bundle tests, process self-metrics — plus a dryrun
-# incident-bundle round-trip against the in-process multi-host harness
-# (controller + 2 worker hosts over real websockets, a fault-injected
-# failure, then `debug_bundle` must return one time-merged artifact).
+# incident-bundle tests, process self-metrics, telemetry history +
+# SLO engine tests (incl. the scrape/undeploy race and chaos legs) —
+# plus two dryruns against the in-process multi-host harness:
+# an incident-bundle round-trip, and an SLO round-trip (inject a
+# latency fault -> firing alert with auto-bundle evidence -> JSON-
+# serializable get_slo_status).
 set -euo pipefail
 
 cd "$(dirname "$0")/../.."
@@ -11,8 +13,9 @@ cd "$(dirname "$0")/../.."
 export JAX_PLATFORMS=cpu
 
 echo "== observability test suites =="
-timeout -k 10 600 python -m pytest \
+timeout -k 10 900 python -m pytest \
     tests/test_observability.py tests/test_metrics.py tests/test_flight.py \
+    tests/test_telemetry.py tests/test_slo.py \
     -q -rA -p no:cacheprovider
 
 echo "== dryrun incident-bundle round-trip =="
@@ -75,6 +78,118 @@ async def main():
     print(
         f"bundle OK: {len(bundle['events'])} events, "
         f"{len(bundle['traces'])} spans, {len(bundle['hosts'])} hosts"
+    )
+    for h in hosts:
+        await h.stop()
+    await controller.stop()
+    await server.stop()
+
+
+asyncio.run(main())
+EOF
+
+echo "== dryrun SLO round-trip (latency fault -> firing -> evidence) =="
+timeout -k 10 180 python - <<'EOF'
+import asyncio, json, time
+
+from bioengine_tpu.cluster.state import ClusterState
+from bioengine_tpu.cluster.topology import TpuTopology
+from bioengine_tpu.rpc.server import RpcServer
+from bioengine_tpu.serving import DeploymentSpec, ServeController, SLOConfig
+from bioengine_tpu.serving.slo import SLOEngine
+from bioengine_tpu.utils import flight
+from bioengine_tpu.utils.telemetry import TelemetryStore
+from bioengine_tpu.worker_host import WorkerHost
+
+
+class SloApp:
+    def __init__(self):
+        self.delay = 0.0
+
+    async def set_delay(self, delay: float = 0.0):
+        self.delay = float(delay)
+        return {"delay": self.delay}
+
+    async def infer(self):
+        if self.delay:
+            await asyncio.sleep(self.delay)
+        return {"ok": True}
+
+
+async def main():
+    server = RpcServer(host="127.0.0.1", admin_users=["admin"])
+    await server.start()
+    token = server.issue_token("admin", is_admin=True)
+    controller = ServeController(
+        ClusterState(TpuTopology(chips=(), n_hosts=1, platform="cpu")),
+        health_check_period=3600,
+    )
+    # second-scale rings so burn windows are drivable in a dryrun
+    controller.telemetry = TelemetryStore(resolutions=[(0.25, 480)])
+    controller.slo = SLOEngine(
+        controller.telemetry,
+        on_page=controller._slo_page_hook,
+        logger=controller.logger,
+    )
+    controller.attach_rpc(server, admin_users=["admin"])
+    hosts = [
+        WorkerHost(server_url=server.url, token=token, host_id=f"h{i}")
+        for i in (1, 2)
+    ]
+    for h in hosts:
+        await h.start()
+    slo = SLOConfig.from_config(
+        {"latency_objective_ms": 100, "latency_percentile": 99,
+         "window": "60s", "for": "0s"}
+    )
+    await controller.deploy(
+        "slo-dryrun",
+        [DeploymentSpec(name="entry", instance_factory=SloApp, slo=slo)],
+    )
+    handle = controller.get_handle("slo-dryrun")
+    controller.telemetry_tick()
+    for _ in range(6):
+        assert (await handle.call("infer"))["ok"]
+    controller.telemetry_tick()
+
+    def alert():
+        return controller.get_slo_status()["deployments"][
+            "slo-dryrun/entry"]["objectives"]["latency"]["alert"]
+
+    assert alert()["state"] == "inactive", alert()
+    # inject the latency fault and burn the budget
+    await handle.call("set_delay", 0.25)
+    for _ in range(8):
+        assert (await handle.call("infer"))["ok"]
+    controller.telemetry_tick()   # -> pending
+    controller.telemetry_tick()   # -> firing
+    a = alert()
+    assert a["state"] == "firing" and a["severity"] == "page", a
+    types = {e["type"] for e in flight.get_events()}
+    assert "slo.firing" in types, types
+    for _ in range(40):           # auto-bundle runs in the background
+        if controller.slo_bundles:
+            break
+        await asyncio.sleep(0.05)
+    assert controller.slo_bundles, "no auto-captured bundle"
+    bundle = controller.slo_bundles[-1]
+    assert bundle["slo_alert"]["objective"] == "latency"
+    assert len(bundle["hosts"]) == 2
+    json.dumps(controller.get_slo_status())  # the verb body serializes
+    # fault clears -> resolved
+    await handle.call("set_delay", 0.0)
+    deadline = time.monotonic() + 8.0
+    while time.monotonic() < deadline:
+        await handle.call("infer")
+        controller.telemetry_tick()
+        if alert()["state"] == "resolved":
+            break
+        await asyncio.sleep(0.1)
+    assert alert()["state"] == "resolved", alert()
+    print(
+        f"slo dryrun OK: firing severity={a['severity']} "
+        f"burn_short={a['burn_short']}, bundle events="
+        f"{len(bundle['events'])}, resolved after clear"
     )
     for h in hosts:
         await h.stop()

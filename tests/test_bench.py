@@ -156,10 +156,11 @@ def test_rpc_transport_stage_schema():
 
 
 def test_observability_overhead_stage_schema():
-    """Pin the observability_overhead artifact schema: four interleaved
-    legs (disabled / unsampled / flight / sampled) over the same live
-    serve path, per-leg p50, the relative + absolute overheads, and the
-    flight-recorder-vs-unsampled delta. The <2% (and flight <1%)
+    """Pin the observability_overhead artifact schema: five interleaved
+    legs (disabled / unsampled / flight / telem / sampled) over the
+    same live serve path, per-leg p50, the relative + absolute
+    overheads, the flight-recorder-vs-unsampled delta, and the
+    push-telemetry-vs-flight delta. The <2% (and flight/telem <1%)
     acceptance numbers come from the full-size driver run — a loaded CI
     core would flake a hard threshold here, so the schema and sanity
     ordering are the contract."""
@@ -183,12 +184,16 @@ def test_observability_overhead_stage_schema():
         "overhead_flight_pct",
         "overhead_flight_abs_us",
         "overhead_flight_vs_unsampled_pct",
+        "overhead_telem_pct",
+        "overhead_telem_abs_us",
+        "overhead_telem_vs_flight_pct",
+        "telem_interval_s",
         "overhead_sampled_pct",
         "overhead_sampled_abs_us",
     ):
         assert key in st, key
     assert st["requests_per_leg"] == 50
-    for leg in ("disabled", "unsampled", "flight", "sampled"):
+    for leg in ("disabled", "unsampled", "flight", "telem", "sampled"):
         assert st["legs"][leg]["p50_us"] > 0, leg
     # full span recording can't be cheaper than the unsampled path's
     # contextvar reads (sanity on the leg wiring, not a perf threshold)
@@ -256,6 +261,104 @@ def test_scheduler_goodput_stage_schema():
     ):
         assert key in unc, key
     assert unc["router_p50_us"] > 0 and unc["scheduler_p50_us"] > 0
+
+
+def _artifact(vit=1000.0, pipelined=2.0, p50_us=100.0) -> dict:
+    """A minimal bench artifact in the real schema, tunable per metric."""
+    return {
+        "metric": "dinov2_vitb14_embed_images_per_sec_per_chip",
+        "value": vit,
+        "unit": "images/sec",
+        "vs_baseline": round(vit / 500.0, 3),
+        "extra": {
+            "pipeline_overlap": {
+                "ok": True,
+                "serial_s": 4.0,
+                "pipelined_s": pipelined,
+                "speedup": round(4.0 / pipelined, 2),
+            },
+            "observability_overhead": {
+                "ok": True,
+                "legs": {"disabled": {"p50_us": p50_us}},
+                "overhead_flight_vs_unsampled_pct": 0.5,
+            },
+            "skipped": {"unet3d": "budget"},
+            "attempts": 1,
+        },
+    }
+
+
+def test_compare_mode_schema_and_exit_codes(tmp_path):
+    """Pin the --compare contract: one JSON line with per-stage deltas
+    and direction-aware regression flags; exit 0 when the candidate
+    holds, non-zero past the tolerance."""
+    a = tmp_path / "a.json"
+    b_ok = tmp_path / "b_ok.json"
+    b_bad = tmp_path / "b_bad.json"
+    a.write_text(json.dumps(_artifact()))
+    # candidate within tolerance (slightly slower, under 10%)
+    b_ok.write_text(json.dumps(_artifact(vit=950.0, pipelined=2.1)))
+    # candidate regressed: headline -30%, pipeline 2x slower
+    b_bad.write_text(json.dumps(_artifact(vit=700.0, pipelined=4.0)))
+
+    def run_compare(b_path):
+        proc = subprocess.run(
+            [sys.executable, str(BENCH), "--compare", str(a), str(b_path)],
+            capture_output=True,
+            text=True,
+            timeout=60,
+            cwd=str(BENCH.parent),
+        )
+        lines = [
+            ln
+            for ln in proc.stdout.strip().splitlines()
+            if ln.startswith("{")
+        ]
+        assert len(lines) == 1, proc.stdout
+        return proc.returncode, json.loads(lines[0])
+
+    rc, ok_report = run_compare(b_ok)
+    assert rc == 0
+    assert ok_report["ok"] is True
+    for key in (
+        "mode",
+        "tolerance_pct",
+        "stages_compared",
+        "stages_only_a",
+        "stages_only_b",
+        "regressions",
+        "improvements",
+        "stages",
+    ):
+        assert key in ok_report, key
+    assert "pipeline_overlap" in ok_report["stages_compared"]
+    assert "headline" in ok_report["stages_compared"]
+    entry = ok_report["stages"]["pipeline_overlap"]["pipelined_s"]
+    assert entry["direction"] == "lower"
+    assert entry["regression"] is False
+
+    rc, bad_report = run_compare(b_bad)
+    assert rc == 1
+    assert bad_report["ok"] is False
+    regressed = {r["metric"] for r in bad_report["regressions"]}
+    assert "headline.images_per_sec_per_chip" in regressed
+    assert "pipeline_overlap.pipelined_s" in regressed
+    # direction inference: the slower pipelined_s also halves speedup —
+    # a higher-is-better metric moving DOWN is a regression too
+    assert "pipeline_overlap.speedup" in regressed
+
+
+def test_compare_usage_error_is_json_not_traceback(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, str(BENCH), "--compare", "only-one.json"],
+        capture_output=True,
+        text=True,
+        timeout=60,
+        cwd=str(BENCH.parent),
+    )
+    assert proc.returncode == 2
+    d = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert d["ok"] is False and "usage" in d["error"]
 
 
 def test_stalled_worker_killed_with_diagnostics_never_rc124():

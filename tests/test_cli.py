@@ -106,7 +106,9 @@ def _cli(live_worker, *args):
     return runner.invoke(
         cli_main,
         list(args)
-        + ["--server-url", live_worker["url"], "--token", live_worker["token"]],
+        # `=` form: a generated token may START with "-" (urlsafe
+        # base64), which a space-separated parse reads as an option
+        + [f"--server-url={live_worker['url']}", f"--token={live_worker['token']}"],
         catch_exceptions=False,
     )
 
@@ -298,8 +300,10 @@ class TestStandaloneUploader:
                     sys.executable,
                     str(REPO_ROOT / "scripts" / "upload_app.py"),
                     str(REPO_ROOT / "apps" / "demo-app"),
-                    "--server-url", w.server.url,
-                    "--token", w.admin_token,
+                    # `=` form: a token_urlsafe value can start with
+                    # "-" and argparse would read it as an option
+                    f"--server-url={w.server.url}",
+                    f"--token={w.admin_token}",
                 ],
                 capture_output=True, text=True, timeout=60,
             )
@@ -324,6 +328,22 @@ def test_cli_cluster_profile_memory(live_worker):
     payload = json.loads(result.stdout)
     assert payload["devices"]
     assert payload["pprof_bytes"] > 0
+
+
+def test_cli_slo_status(live_worker):
+    result = _cli(live_worker, "slo", "status")
+    assert result.exit_code == 0, result.stdout
+    payload = json.loads(result.stdout)
+    assert "deployments" in payload
+    assert "auto_bundles" in payload
+
+
+def test_cli_top(live_worker):
+    result = _cli(live_worker, "top")
+    assert result.exit_code == 0, result.stdout
+    payload = json.loads(result.stdout)
+    assert "telemetry" in payload and "slo" in payload
+    assert "store" in payload["telemetry"]
 
 
 def test_read_dir_files_skips_hidden_dirs(tmp_path):

@@ -281,3 +281,74 @@ class TestProcessSelfMetrics:
             metrics.monitor_event_loop(interval_s=0.02), timeout=1.0
         )
         t1.cancel()
+
+
+class TestCardinalityGuard:
+    """A hostile/buggy caller cannot grow a labeled family without
+    bound: children cap at BIOENGINE_METRICS_MAX_LABELS, overflow folds
+    into one __overflow__ child, and the drops are counted."""
+
+    def test_bounded_children_under_10k_distinct_labels(self, monkeypatch):
+        monkeypatch.setenv("BIOENGINE_METRICS_MAX_LABELS", "50")
+        metrics.reset_env_cache()
+        try:
+            reg = MetricsRegistry()
+            fam = reg.counter("rpc_calls_total", "", ("method",))
+            dropped_before = metrics.DROPPED_LABELS.labels(
+                "rpc_calls_total"
+            ).value
+            for i in range(10_000):
+                fam.labels(f"method-{i}").inc()
+            # memory bound: cap + the one overflow child
+            assert len(fam.items()) <= 51
+            overflow = fam.labels(metrics.OVERFLOW_LABEL)
+            assert overflow.value == 10_000 - 50
+            dropped = (
+                metrics.DROPPED_LABELS.labels("rpc_calls_total").value
+                - dropped_before
+            )
+            assert dropped == 10_000 - 50
+            # existing children keep working normally at the cap
+            fam.labels("method-0").inc()
+            assert fam.labels("method-0").value == 2
+            # the overflow child renders/collects like any other
+            snap = reg.collect()
+            labels = [
+                s["labels"]["method"]
+                for s in snap["rpc_calls_total"]["series"]
+            ]
+            assert metrics.OVERFLOW_LABEL in labels
+        finally:
+            metrics.reset_env_cache()
+
+    def test_unlabeled_families_are_never_capped(self, monkeypatch):
+        monkeypatch.setenv("BIOENGINE_METRICS_MAX_LABELS", "1")
+        metrics.reset_env_cache()
+        try:
+            reg = MetricsRegistry()
+            g = reg.gauge("uptime_seconds", "")
+            g.set(5.0)  # the single unlabeled child must not overflow
+            assert g.labels().value == 5.0
+        finally:
+            metrics.reset_env_cache()
+
+    def test_warns_once_per_family(self, monkeypatch, caplog):
+        import logging
+
+        monkeypatch.setenv("BIOENGINE_METRICS_MAX_LABELS", "2")
+        metrics.reset_env_cache()
+        try:
+            reg = MetricsRegistry()
+            fam = reg.counter("warn_once_total", "", ("k",))
+            with caplog.at_level(logging.WARNING, logger="bioengine.metrics"):
+                for i in range(20):
+                    fam.labels(str(i)).inc()
+            warnings = [
+                r
+                for r in caplog.records
+                if "label-cardinality cap" in r.message
+                and "warn_once_total" in r.message
+            ]
+            assert len(warnings) == 1
+        finally:
+            metrics.reset_env_cache()

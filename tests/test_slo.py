@@ -1,0 +1,744 @@
+"""The SLO engine closing the observability loop (ISSUE 10).
+
+End-to-end acceptance on the in-process multi-host harness: a
+deployment with a manifest ``slo:`` block under injected latency
+faults transitions pending -> firing (flight event, metric,
+auto-captured debug bundle) and -> resolved after the fault clears,
+with zero failed requests. Plus: the chaos availability leg (host
+killed mid-soak), scrape/undeploy races, the clock-skew handshake,
+config validation, the anomaly detectors, and the scheduler's
+burn-pressure hook.
+"""
+
+import asyncio
+import json
+import time
+from pathlib import Path
+
+import aiohttp
+import pytest
+
+from bioengine_tpu.apps.builder import AppBuildError, AppBuilder
+from bioengine_tpu.apps.manifest import ManifestError, validate_manifest
+from bioengine_tpu.cluster.state import ClusterState
+from bioengine_tpu.cluster.topology import TpuTopology
+from bioengine_tpu.rpc.client import ServerConnection
+from bioengine_tpu.rpc.server import RpcServer
+from bioengine_tpu.serving import (
+    DeploymentSpec,
+    SchedulingConfig,
+    ServeController,
+    SLOConfig,
+)
+from bioengine_tpu.serving.slo import ResidualDetector, SLOEngine
+from bioengine_tpu.utils import flight, metrics
+from bioengine_tpu.utils.telemetry import TelemetryStore
+from bioengine_tpu.worker_host import WorkerHost
+
+pytestmark = [pytest.mark.integration, pytest.mark.anyio]
+
+
+def _no_local_chips() -> ClusterState:
+    return ClusterState(TpuTopology(chips=(), n_hosts=1, platform="cpu"))
+
+
+def _fine_telemetry(controller, step=0.25, slots=480) -> None:
+    """Second-scale rings so burn windows are drivable in a test; must
+    run BEFORE deploy (the engine holds the store and registrations)."""
+    controller.telemetry = TelemetryStore(resolutions=[(step, slots)])
+    controller.slo = SLOEngine(
+        controller.telemetry,
+        on_page=controller._slo_page_hook,
+        logger=controller.logger,
+    )
+
+
+SLO_MANIFEST = """\
+name: SLO App
+id: slo-app
+id_emoji: "\U0001F6A8"
+description: slo engine proof app
+type: tpu-serve
+version: 1.0.0
+deployments:
+  - slo_dep:SloDep
+authorized_users: ["*"]
+deployment_config:
+  slo_dep:
+    num_replicas: {num_replicas}
+    min_replicas: {num_replicas}
+    max_replicas: {num_replicas}
+    chips: 2
+    autoscale: false
+    slo:
+      latency_objective_ms: 100
+      latency_percentile: 99
+      availability: 99.9
+      window: 60s
+      for: {for_s}
+"""
+
+SLO_SOURCE = '''\
+import asyncio
+
+from bioengine_tpu.rpc import schema_method
+
+
+class SloDep:
+    async def async_init(self):
+        self.delay = 0.0
+
+    @schema_method
+    async def set_delay(self, delay: float = 0.0, context=None):
+        """Latency fault injection: every subsequent infer sleeps."""
+        self.delay = float(delay)
+        return {"delay": self.delay}
+
+    @schema_method
+    async def infer(self, context=None):
+        """One request; succeeds always, slowly under the fault."""
+        if self.delay:
+            await asyncio.sleep(self.delay)
+        return {"ok": True}
+'''
+
+
+def _write_slo_app(tmp_path: Path, num_replicas=1, for_s="0.3s") -> Path:
+    app_dir = tmp_path / "slo-src"
+    app_dir.mkdir(exist_ok=True)
+    (app_dir / "manifest.yaml").write_text(
+        SLO_MANIFEST.format(num_replicas=num_replicas, for_s=for_s)
+    )
+    (app_dir / "slo_dep.py").write_text(SLO_SOURCE)
+    return app_dir
+
+
+@pytest.fixture()
+async def slo_plane(tmp_path):
+    server = RpcServer(host="127.0.0.1", admin_users=["admin"])
+    await server.start()
+    token = server.issue_token("admin", is_admin=True)
+    controller = ServeController(_no_local_chips(), health_check_period=3600)
+    _fine_telemetry(controller)
+    controller.attach_rpc(server, admin_users=["admin"])
+    hosts = []
+
+    async def spawn_host(host_id: str, rejoin: bool = True) -> WorkerHost:
+        host = WorkerHost(
+            server_url=server.url,
+            token=token,
+            host_id=host_id,
+            workspace_dir=tmp_path / f"ws-{host_id}",
+            rejoin=rejoin,
+        )
+        await host.start()
+        hosts.append(host)
+        return host
+
+    try:
+        yield server, controller, spawn_host, tmp_path
+    finally:
+        for host in hosts:
+            try:
+                await host.stop()
+            except Exception:
+                pass
+        await controller.stop()
+        await server.stop()
+
+
+async def _deploy_slo_app(controller, tmp_path, num_replicas=1, for_s="0.3s"):
+    builder = AppBuilder(workdir_root=tmp_path / "apps")
+    built = builder.build(
+        app_id="slo-app",
+        local_path=_write_slo_app(tmp_path, num_replicas, for_s),
+    )
+    await controller.deploy("slo-app", built.specs)
+    return built
+
+
+def _alert(controller, objective):
+    status = controller.get_slo_status()
+    return status["deployments"]["slo-app/slo_dep"]["objectives"][objective][
+        "alert"
+    ]
+
+
+class TestEndToEndLatencySLO:
+    async def test_latency_fault_pending_firing_resolved(self, slo_plane):
+        """Acceptance: injected latency -> pending -> firing (flight
+        event + slo_alerts_total + auto-captured bundle) -> resolved
+        after the fault clears; zero failed requests throughout."""
+        server, controller, spawn_host, tmp_path = slo_plane
+        await spawn_host("h1")
+        built = await _deploy_slo_app(controller, tmp_path)
+        spec = next(s for s in built.specs if s.name == "slo_dep")
+        assert spec.slo is not None and spec.slo.latency_objective_s == 0.1
+        handle = controller.get_handle("slo-app", "slo_dep")
+        flight.clear()
+
+        ok = 0
+        controller.telemetry_tick()  # delta baseline
+        for _ in range(8):
+            assert (await handle.call("infer"))["ok"]
+            ok += 1
+        controller.telemetry_tick()
+        assert _alert(controller, "latency")["state"] == "inactive"
+
+        # inject the latency fault: every request now takes 250 ms
+        await handle.call("set_delay", 0.25)
+        for _ in range(10):
+            assert (await handle.call("infer"))["ok"]
+            ok += 1
+        controller.telemetry_tick()
+        alert = _alert(controller, "latency")
+        assert alert["state"] == "pending", alert
+        assert alert["severity"] == "page"
+
+        # hold past for_s (0.3 s) with the fault still burning
+        await asyncio.sleep(0.35)
+        for _ in range(3):
+            assert (await handle.call("infer"))["ok"]
+            ok += 1
+        controller.telemetry_tick()
+        alert = _alert(controller, "latency")
+        assert alert["state"] == "firing", alert
+
+        # the firing left all three artifacts: flight events, the
+        # counter, and the auto-captured cross-host bundle
+        types = [e["type"] for e in flight.get_events()]
+        assert "slo.pending" in types and "slo.firing" in types
+        snap = metrics.collect()
+        fired = [
+            s
+            for s in snap["slo_alerts_total"]["series"]
+            if s["labels"]
+            == {"app": "slo-app", "deployment": "slo_dep", "severity": "page"}
+        ]
+        assert fired and fired[0]["value"] >= 1
+        for _ in range(40):  # the bundle task runs in the background
+            if controller.slo_bundles:
+                break
+            await asyncio.sleep(0.05)
+        assert controller.slo_bundles, "no auto-captured bundle"
+        bundle = controller.slo_bundles[-1]
+        assert bundle["slo_alert"]["objective"] == "latency"
+        assert bundle["hosts"]["h1"]["reachable"]
+        json.dumps(bundle, default=str)  # incident artifact serializes
+
+        # clear the fault; good traffic drains the short+long windows
+        await handle.call("set_delay", 0.0)
+        deadline = time.monotonic() + 8.0
+        while time.monotonic() < deadline:
+            assert (await handle.call("infer"))["ok"]
+            ok += 1
+            controller.telemetry_tick()
+            if _alert(controller, "latency")["state"] == "resolved":
+                break
+            await asyncio.sleep(0.1)
+        alert = _alert(controller, "latency")
+        assert alert["state"] == "resolved", alert
+        assert "slo.resolved" in [e["type"] for e in flight.get_events()]
+        # every request of the whole proof succeeded
+        assert ok >= 21
+        # the whole status surface is JSON-able (the get_slo_status verb)
+        json.dumps(controller.get_slo_status())
+
+
+class TestChaosAvailabilitySLO:
+    async def test_host_killed_mid_soak_fires_availability_burn(
+        self, slo_plane
+    ):
+        """Chaos leg: sever one host's control-plane connection
+        mid-soak; the failed requests burn the availability budget
+        (firing + flight event + auto-bundle), and after the host
+        rejoins and good traffic resumes the alert resolves."""
+        server, controller, spawn_host, tmp_path = slo_plane
+        h1 = await spawn_host("h1")
+        await spawn_host("h2")
+        await _deploy_slo_app(controller, tmp_path, num_replicas=2, for_s="0s")
+        handle = controller.get_handle("slo-app", "slo_dep")
+        flight.clear()
+
+        controller.telemetry_tick()
+        for _ in range(8):
+            await handle.call("infer")
+        controller.telemetry_tick()
+        assert _alert(controller, "availability")["state"] == "inactive"
+
+        # kill h1's websocket MID-SOAK: a slow wave is in flight on
+        # both hosts when the connection dies, so the calls executing
+        # on h1 fail ambiguously (non-idempotent -> surfaced typed to
+        # the caller, never silently retried) — the availability burn.
+        # Auto-heal is suppressed so the outage window is deterministic.
+        await handle.call("set_delay", 0.1)
+
+        async def one() -> int:
+            try:
+                await handle.call("infer")
+                return 0
+            except Exception:
+                return 1
+
+        wave = [asyncio.create_task(one()) for _ in range(12)]
+        await asyncio.sleep(0.03)   # wave is mid-flight on both hosts
+        h1.connection.auto_reconnect = False
+        await h1.connection._abort_connection()
+        failures = sum(await asyncio.gather(*wave))
+        assert failures > 0, "the kill produced no failed requests"
+        await handle.call("set_delay", 0.0)
+        controller.telemetry_tick()   # -> pending (for: 0s)
+        controller.telemetry_tick()   # -> firing on the next pass
+        alert = _alert(controller, "availability")
+        assert alert["state"] == "firing", alert
+        assert "slo.firing" in [e["type"] for e in flight.get_events()]
+        for _ in range(40):
+            if controller.slo_bundles:
+                break
+            await asyncio.sleep(0.05)
+        assert controller.slo_bundles
+
+        # rejoin: re-run the client's reconnect loop (re-establish +
+        # re-register + the host's _rejoin_cluster hook re-announcing
+        # its warm replica for re-adoption)
+        h1.connection.auto_reconnect = True
+        await h1.connection._reconnect_loop()
+        assert h1.connection.connected, "host never rejoined"
+
+        # good traffic drains the windows -> resolved
+        deadline = time.monotonic() + 8.0
+        while time.monotonic() < deadline:
+            await handle.call("infer")
+            controller.telemetry_tick()
+            if _alert(controller, "availability")["state"] == "resolved":
+                break
+            await asyncio.sleep(0.1)
+        assert _alert(controller, "availability")["state"] == "resolved"
+
+
+class TestScrapeUndeployRaces:
+    async def test_concurrent_scrapes_during_churn_never_error(
+        self, slo_plane
+    ):
+        """GET /metrics + get_app_status + get_telemetry +
+        get_slo_status racing a deploy/undeploy loop: no errors, and a
+        swept deployment's series never reported as live."""
+        server, controller, spawn_host, tmp_path = slo_plane
+        await spawn_host("h1")
+        errors: list = []
+        stop = asyncio.Event()
+
+        async def scraper():
+            async with aiohttp.ClientSession() as session:
+                while not stop.is_set():
+                    try:
+                        async with session.get(
+                            server.http_url + "/metrics"
+                        ) as resp:
+                            assert resp.status == 200
+                            await resp.text()
+                        try:
+                            controller.get_app_status("slo-app")
+                        except KeyError:
+                            pass  # mid-churn: the app may be gone
+                        controller.get_telemetry()
+                        json.dumps(controller.get_slo_status())
+                    except Exception as e:  # noqa: BLE001 — the assertion
+                        errors.append(e)
+                    await asyncio.sleep(0.01)
+
+        scrape_task = asyncio.create_task(scraper())
+        builder = AppBuilder(workdir_root=tmp_path / "apps")
+        built = builder.build(
+            app_id="slo-app", local_path=_write_slo_app(tmp_path)
+        )
+        try:
+            for _ in range(4):
+                await controller.deploy("slo-app", built.specs)
+                handle = controller.get_handle("slo-app", "slo_dep")
+                for _ in range(3):
+                    await handle.call("infer")
+                controller.telemetry_tick()
+                await controller.undeploy("slo-app")
+        finally:
+            stop.set()
+            await scrape_task
+        assert errors == [], errors
+        # swept: no live telemetry series for the undeployed app
+        telem = controller.get_telemetry()
+        assert not [
+            k for k in telem["deployments"] if k.startswith("slo-app/")
+        ]
+        assert "slo-app/slo_dep" not in controller.get_slo_status()[
+            "deployments"
+        ]
+
+
+class TestClockSkew:
+    async def test_skewed_host_reports_and_corrects(
+        self, slo_plane, monkeypatch
+    ):
+        """Satellite: a host whose clock runs 5 s fast reports
+        clock_skew_s at the handshake; the bundle annotates it and the
+        merged timeline is ordered on the controller's clock."""
+        server, controller, spawn_host, tmp_path = slo_plane
+
+        async def skewed_probe(self, samples: int = 3):
+            # the host's wall clock is 5 s AHEAD of the controller's:
+            # the RTT-midpoint offset (server - local) comes out -5
+            self.clock_offset_s = -5.0
+            self.clock_offset_rtt_s = 0.001
+            return {"offset_s": -5.0, "rtt_s": 0.001, "samples": samples}
+
+        monkeypatch.setattr(
+            ServerConnection, "measure_clock_offset", skewed_probe
+        )
+        host = await spawn_host("h-skew")
+        assert host.clock_skew_s == pytest.approx(5.0)
+        assert controller.cluster_state.hosts[
+            "h-skew"
+        ].clock_skew_s == pytest.approx(5.0)
+        record = host.get_flight_record(limit=10)
+        assert record["clock_skew_s"] == pytest.approx(5.0)
+
+        bundle = await controller.debug_bundle()
+        assert bundle["hosts"]["h-skew"]["clock_skew_s"] == pytest.approx(5.0)
+
+        # push_telemetry de-skews captured_at: a sample stamped by the
+        # fast host's clock (now+5) lands in a bucket at ~now, not in a
+        # future bucket that would swallow on-time samples behind it
+        caller = server.validate_token(
+            server.issue_token("admin", is_admin=True)
+        )
+        now = time.time()
+        await server.call_service_method(
+            "serve-router",
+            "push_telemetry",
+            (
+                "h-skew",
+                {
+                    "captured_at": now + 5.0,
+                    "source_id": "other-process",
+                    "deployments": {"skew-app/dep": {"requests": 3}},
+                },
+            ),
+            caller=caller,
+        )
+        points = controller.telemetry.series(
+            "skew-app", "dep", "request_rate", now=now
+        )
+        assert points, "push not ingested"
+        assert points[-1]["t"] <= now + 0.5  # de-skewed, not future-dated
+
+    def test_merge_records_orders_skewed_events(self):
+        """A +-5 s skewed host's events sort where they actually
+        happened, with the applied skew annotated per event."""
+        base = 1_000_000.0
+        controller_rec = {
+            "recorder": "ctrl",
+            "events": [
+                {"recorder": "ctrl", "seq": 1, "ts": base + 0.0, "type": "a"},
+                {"recorder": "ctrl", "seq": 2, "ts": base + 1.0, "type": "c"},
+            ],
+        }
+        fast_host = {   # clock 5 s ahead; event really happened at +0.5
+            "recorder": "h1",
+            "clock_skew_s": 5.0,
+            "events": [
+                {"recorder": "h1", "seq": 1, "ts": base + 5.5, "type": "b"},
+            ],
+        }
+        slow_host = {   # clock 5 s behind; event really happened at +1.5
+            "recorder": "h2",
+            "clock_skew_s": -5.0,
+            "events": [
+                {"recorder": "h2", "seq": 1, "ts": base - 3.5, "type": "d"},
+            ],
+        }
+        merged = flight.merge_records([controller_rec, fast_host, slow_host])
+        assert [e["type"] for e in merged] == ["a", "b", "c", "d"]
+        corrected = {e["type"]: e for e in merged}
+        assert corrected["b"]["ts"] == pytest.approx(base + 0.5)
+        assert corrected["b"]["ts_raw"] == pytest.approx(base + 5.5)
+        assert corrected["b"]["clock_skew_s"] == 5.0
+        assert corrected["d"]["ts"] == pytest.approx(base + 1.5)
+        # unskewed events untouched
+        assert "ts_raw" not in corrected["a"]
+
+
+class TestSLOConfig:
+    def test_parsing_and_validation(self):
+        cfg = SLOConfig.from_config(
+            {
+                "latency_objective_ms": 250,
+                "latency_percentile": 99,
+                "availability": 99.9,
+                "window": "24h",
+                "for": "2m",
+            }
+        )
+        assert cfg.latency_objective_s == 0.25
+        assert cfg.window_s == 86400.0
+        assert cfg.for_s == 120.0
+        assert cfg.objectives() == ["latency", "availability"]
+        assert cfg.budget("latency") == pytest.approx(0.01)
+        assert cfg.budget("availability") == pytest.approx(0.001)
+        with pytest.raises(ValueError, match="unknown slo keys"):
+            SLOConfig.from_config({"latency_objective_ms": 1, "typo": 2})
+        with pytest.raises(ValueError, match="needs latency_objective"):
+            SLOConfig.from_config({"window": "1h"})
+        with pytest.raises(ValueError, match="latency_percentile"):
+            SLOConfig.from_config(
+                {"latency_objective_ms": 1, "latency_percentile": 100}
+            )
+        # the fraction foot-gun: 0.999 meaning 99.9% must fail the
+        # build, not produce an SLO that can never alert
+        with pytest.raises(ValueError, match="not 0.999"):
+            SLOConfig.from_config({"availability": 0.999})
+        with pytest.raises(ValueError, match="not 0.999"):
+            SLOConfig.from_config(
+                {"latency_objective_ms": 1, "latency_percentile": 0.99}
+            )
+
+    def test_status_flags_window_truncation(self):
+        """A 30d objective on a store that only holds minutes of
+        history must LABEL the truncation, not report a full-window
+        budget figure computed from the covered slice."""
+        store = TelemetryStore(resolutions=[(1.0, 60)])  # 60s coverage
+        engine = SLOEngine(store)
+        engine.register(
+            "a", "d",
+            SLOConfig.from_config({"availability": 99.9, "window": "30d"}),
+        )
+        status = engine.status()
+        o = status["deployments"]["a/d"]["objectives"]["availability"]
+        assert o["window_s"] == 30 * 86400.0
+        assert o["window_truncated"] is True
+        assert o["window_coverage_s"] == 60.0
+
+    def test_manifest_rejects_non_mapping_slo(self):
+        data = {
+            "name": "x",
+            "id": "x",
+            "id_emoji": "x",
+            "description": "x",
+            "type": "tpu-serve",
+            "deployments": ["d:D"],
+            "deployment_config": {"d": {"slo": "99.9"}},
+        }
+        with pytest.raises(ManifestError, match="slo must be a mapping"):
+            validate_manifest(data)
+
+    def test_builder_fails_typed_on_bad_slo(self, tmp_path):
+        app_dir = tmp_path / "bad-slo"
+        app_dir.mkdir()
+        (app_dir / "manifest.yaml").write_text(
+            SLO_MANIFEST.format(num_replicas=1, for_s="0s").replace(
+                "latency_objective_ms: 100", "latency_objective_ms: 100\n      bogus_key: 1"
+            )
+        )
+        (app_dir / "slo_dep.py").write_text(SLO_SOURCE)
+        builder = AppBuilder(workdir_root=tmp_path / "apps")
+        with pytest.raises(AppBuildError, match="slo config"):
+            builder.build(app_id="bad", local_path=app_dir)
+
+
+class TestEscalationWhileFiring:
+    def test_ticket_firing_escalating_to_page_refires_with_evidence(self):
+        """The slow-then-fast burn: an alert already firing at ticket
+        severity that crosses the page threshold must RE-fire — page
+        counter incremented, flight event recorded, auto-bundle hook
+        invoked — not silently relabel itself."""
+        store = TelemetryStore(resolutions=[(0.5, 240)])
+        pages: list = []
+        engine = SLOEngine(store, on_page=pages.append)
+        cfg = SLOConfig.from_config(
+            {"latency_objective_ms": 100, "latency_percentile": 99,
+             "window": "60s", "for": "0s"}
+        )
+        engine.register("esc-app", "dep", cfg)
+        flight.clear()
+        now = time.time()
+
+        def push(t, bad, good):
+            store.ingest(
+                {
+                    "captured_at": t,
+                    "deployments": {
+                        "esc-app/dep": {
+                            "requests": bad + good,
+                            "latency_buckets": {
+                                "0.1": good, "0.5": bad + good
+                            },
+                        }
+                    },
+                }
+            )
+
+        # burn 10x (between ticket 6 and page 14.4): 10% bad
+        for i in range(4):
+            push(now - 2 + i * 0.5, bad=1, good=9)
+        engine.evaluate(now=now)      # -> pending (ticket)
+        engine.evaluate(now=now)      # -> firing (ticket)
+        key = ("esc-app", "dep", "latency")
+        assert engine._alerts[key].state == "firing"
+        assert engine._alerts[key].severity == "ticket"
+        assert pages == []
+
+        # the burn accelerates to 100x: page threshold crossed
+        for i in range(4):
+            push(now + i * 0.5, bad=10, good=0)
+        engine.evaluate(now=now + 2)
+        alert = engine._alerts[key]
+        assert alert.state == "firing" and alert.severity == "page"
+        assert len(pages) == 1, "page hook must run on escalation"
+        snap = metrics.collect()
+        fired = [
+            s
+            for s in snap["slo_alerts_total"]["series"]
+            if s["labels"]
+            == {"app": "esc-app", "deployment": "dep", "severity": "page"}
+        ]
+        assert fired and fired[0]["value"] >= 1
+
+
+class TestAnomalyDetection:
+    def test_residual_detector_flags_spike_not_noise(self):
+        det = ResidualDetector(min_points=8, consecutive=2, min_delta=0.01)
+        import random
+
+        rng = random.Random(0)
+        for _ in range(50):
+            assert not det.observe(0.1 + rng.uniform(-0.005, 0.005))
+        # a sustained 10x excursion flags on the 2nd consecutive point
+        assert not det.observe(1.0)
+        assert det.observe(1.0)
+        # ...and a PERSISTENT level shift is one event, not forever:
+        # the flagged point inflates the EW variance, so the new level
+        # stops flagging and becomes the baseline
+        repeat_flags = sum(det.observe(1.0) for _ in range(30))
+        assert repeat_flags <= 2, repeat_flags
+        # a single blip does not
+        det2 = ResidualDetector(min_points=8, consecutive=2, min_delta=0.01)
+        for _ in range(50):
+            det2.observe(0.1 + rng.uniform(-0.005, 0.005))
+        assert not det2.observe(1.0)
+        assert not det2.observe(0.1)
+
+    def test_engine_emits_warn_event_on_latency_excursion(self):
+        store = TelemetryStore(resolutions=[(1.0, 600)])
+        engine = SLOEngine(store)
+        engine.register(
+            "a", "d", SLOConfig.from_config({"availability": 99.9})
+        )
+        flight.clear()
+        now = time.time()
+        t0 = now - 120
+        for i in range(100):
+            store.ingest(
+                {
+                    "captured_at": t0 + i,
+                    "deployments": {
+                        "a/d": {
+                            "requests": 10,
+                            "latency_buckets": {"0.1": 10, "0.5": 10},
+                        }
+                    },
+                }
+            )
+        engine.evaluate(now=t0 + 101)
+        assert not [
+            e for e in flight.get_events() if e["type"] == "anomaly.detect"
+        ]
+        # p99 jumps 0.1 -> 0.5 for several buckets
+        for i in range(4):
+            store.ingest(
+                {
+                    "captured_at": t0 + 100 + i,
+                    "deployments": {
+                        "a/d": {
+                            "requests": 10,
+                            "latency_buckets": {"0.1": 0, "0.5": 10},
+                        }
+                    },
+                }
+            )
+        status = engine.evaluate(now=t0 + 105)
+        events = [
+            e for e in flight.get_events() if e["type"] == "anomaly.detect"
+        ]
+        assert events, "excursion not flagged"
+        assert events[0]["attrs"]["series"] == "latency_p99"
+        assert events[0]["severity"] == "warning"
+        assert status["anomalies"]
+
+
+class TestSchedulerBurnPressure:
+    async def test_burn_pressure_forces_scale_up(self):
+        """The closed loop (opt-in): page-rate budget burn upgrades a
+        'hold' verdict to 'up' on the predictive autoscaler."""
+
+        class App:
+            async def infer(self):
+                return 1
+
+        controller = ServeController(_no_local_chips(), health_check_period=3600)
+        _fine_telemetry(controller)
+        spec = DeploymentSpec(
+            name="entry",
+            instance_factory=App,
+            scheduling=SchedulingConfig(slo_pressure=True),
+            slo=SLOConfig.from_config(
+                {"latency_objective_ms": 100, "window": "60s"}
+            ),
+        )
+        try:
+            await controller.deploy("burn-app", [spec])
+            scheduler = controller._schedulers[("burn-app", "entry")]
+            assert scheduler.pressure_fn is not None
+            # no burn: predictor idle -> hold
+            decision, proj = scheduler.scale_decision(1)
+            assert decision == "hold"
+            assert proj["slo_pressure"] == 0.0
+            # feed the store an all-bad window -> page-rate burn
+            now = time.time()
+            for i in range(8):
+                controller.telemetry.ingest(
+                    {
+                        "captured_at": now - 2 + i * 0.25,
+                        "deployments": {
+                            "burn-app/entry": {
+                                "requests": 10,
+                                "latency_buckets": {"0.1": 0, "0.5": 10},
+                            }
+                        },
+                    }
+                )
+            controller.slo.evaluate(now=now)
+            assert controller.slo.burn_pressure("burn-app", "entry") >= 1.0
+            decision, proj = scheduler.scale_decision(1)
+            assert decision == "up"
+            assert proj["slo_pressure"] >= 1.0
+        finally:
+            await controller.stop()
+
+    async def test_pressure_hook_absent_without_opt_in(self):
+        class App:
+            async def infer(self):
+                return 1
+
+        controller = ServeController(_no_local_chips(), health_check_period=3600)
+        spec = DeploymentSpec(
+            name="entry",
+            instance_factory=App,
+            scheduling=SchedulingConfig(),   # slo_pressure defaults off
+            slo=SLOConfig.from_config({"availability": 99.9}),
+        )
+        try:
+            await controller.deploy("plain-app", [spec])
+            assert (
+                controller._schedulers[("plain-app", "entry")].pressure_fn
+                is None
+            )
+        finally:
+            await controller.stop()

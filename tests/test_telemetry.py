@@ -1,0 +1,242 @@
+"""Telemetry history: the fixed-memory multi-resolution store and the
+registry-delta sampler (utils/telemetry.py).
+
+Acceptance pins (ISSUE 10): the store is fixed-memory under 3x
+sustained push load, and ``get_telemetry`` reconstructs rate/p99
+series that agree with the live registry within quantile-bucket error.
+"""
+
+import time
+
+import pytest
+
+from bioengine_tpu.cluster.state import ClusterState
+from bioengine_tpu.cluster.topology import TpuTopology
+from bioengine_tpu.serving import DeploymentSpec, ServeController
+from bioengine_tpu.utils import metrics
+from bioengine_tpu.utils.telemetry import (
+    RegistrySampler,
+    TelemetryStore,
+    quantile_from_buckets,
+)
+
+pytestmark = pytest.mark.anyio
+
+
+def _snap(t, key="app/dep", requests=10, errors=0, buckets=None, **extra):
+    return {
+        "captured_at": t,
+        "deployments": {
+            key: {
+                "requests": requests,
+                "errors": errors,
+                "latency_buckets": buckets
+                or {"0.1": requests, "0.25": requests, "0.5": requests},
+                **extra,
+            }
+        },
+    }
+
+
+class TestStoreBounds:
+    def test_rings_stay_fixed_under_3x_push_load(self):
+        """3x the coarsest ring's capacity in pushes: every ring stays
+        at its maxlen, nothing grows with the push count."""
+        store = TelemetryStore(resolutions=[(1.0, 30), (5.0, 20)])
+        t0 = time.time()
+        n_pushes = 3 * 20 * 5  # 3x the coarse ring's span in 1s steps
+        for i in range(n_pushes):
+            store.ingest(_snap(t0 + i), host_id=f"h{i % 3}")
+        s = store._series[("app", "dep")]
+        for step, ring in s.rings:
+            assert len(ring) == ring.maxlen, step
+        # series reads stay bounded too
+        assert len(store.series("app", "dep", "request_rate")) <= 30
+
+    def test_deployment_key_set_is_lru_bounded(self):
+        store = TelemetryStore(
+            resolutions=[(1.0, 10)], max_series=8
+        )
+        t0 = time.time()
+        for i in range(100):
+            store.ingest(_snap(t0 + i, key=f"app{i}/dep"))
+        assert len(store.keys()) == 8
+        # newest keys survived
+        assert ("app99", "dep") in store.keys()
+
+    def test_malformed_push_is_rejected_not_raised(self):
+        store = TelemetryStore(resolutions=[(1.0, 10)])
+        assert store.ingest(None) == 0
+        assert store.ingest({"deployments": "nope"}) == 0
+        assert store.ingest({"deployments": {"a/b": "nope"}}) == 0
+        assert store.keys() == []
+
+    def test_sweep_drops_dead_deployment_series(self):
+        store = TelemetryStore(resolutions=[(1.0, 10)])
+        t = time.time()
+        store.ingest(_snap(t, key="a/x"))
+        store.ingest(_snap(t, key="a/y"))
+        store.ingest(_snap(t, key="b/x"))
+        store.sweep("a", "x")
+        assert store.keys() == [("a", "y"), ("b", "x")]
+        store.sweep("a")
+        assert store.keys() == [("b", "x")]
+
+
+class TestSeriesReconstruction:
+    def test_rates_and_quantiles_from_deltas(self):
+        store = TelemetryStore(resolutions=[(1.0, 60)])
+        t0 = time.time() - 10
+        for i in range(10):
+            store.ingest(
+                _snap(
+                    t0 + i,
+                    requests=20,
+                    errors=2,
+                    buckets={"0.1": 10, "0.25": 19, "0.5": 20},
+                    queue_depth=4,
+                    chip_seconds=1.5,
+                    shed=1,
+                )
+            )
+        rate = store.series("app", "dep", "request_rate")
+        assert rate[-1]["value"] == 20.0
+        assert store.series("app", "dep", "error_rate")[-1]["value"] == 2.0
+        assert store.series("app", "dep", "error_ratio")[-1]["value"] == 0.1
+        assert store.series("app", "dep", "shed_rate")[-1]["value"] == 1.0
+        assert store.series("app", "dep", "queue_depth")[-1]["value"] == 4
+        assert store.series("app", "dep", "chip_seconds")[-1]["value"] == 1.5
+        # p50 lands in the first bucket that covers half the requests
+        assert store.series("app", "dep", "latency_p50")[-1]["value"] == 0.1
+        assert store.series("app", "dep", "latency_p99")[-1]["value"] == 0.5
+
+    def test_window_aggregate_folds_buckets(self):
+        store = TelemetryStore(resolutions=[(1.0, 60)])
+        now = time.time()
+        for i in range(20):
+            store.ingest(_snap(now - 20 + i, requests=5))
+        agg = store.window_aggregate("app", "dep", 10.0, now=now)
+        # ~10 buckets of 5 requests (edge alignment may include one more)
+        assert 45 <= agg["requests"] <= 55
+        assert agg["latency_buckets"]["0.5"] == agg["requests"]
+
+    def test_resolution_selection_prefers_finest_that_covers(self):
+        store = TelemetryStore(resolutions=[(1.0, 10), (10.0, 10)])
+        now = time.time()
+        for i in range(100):
+            store.ingest(_snap(now - 100 + i))
+        fine = store.series("app", "dep", "request_rate", resolution=1.0)
+        coarse = store.series(
+            "app", "dep", "request_rate", since=now - 90
+        )
+        # a 90s window cannot come from the 10-slot 1s ring
+        assert len(coarse) >= 9
+        assert all(p["value"] == 10.0 for p in fine)
+        # edge buckets are partial depending on wall-clock alignment;
+        # every interior bucket holds the full 10 req/s
+        assert all(0 < p["value"] <= 10.0 for p in coarse)
+        assert all(p["value"] == 10.0 for p in coarse[1:-1])
+
+    def test_unknown_series_name_is_none_not_crash(self):
+        store = TelemetryStore(resolutions=[(1.0, 10)])
+        store.ingest(_snap(time.time()))
+        assert store.series("app", "dep", "latency_p95")[-1]["value"] == 0.1
+
+    def test_quantile_estimator_matches_registry_convention(self):
+        buckets = {"0.1": 50, "0.25": 90, "0.5": 100}
+        assert quantile_from_buckets(buckets, 100, 0.5) == 0.1
+        assert quantile_from_buckets(buckets, 100, 0.95) == 0.5
+        assert quantile_from_buckets({}, 0, 0.5) is None
+
+
+class TestRegistrySampler:
+    def test_deltas_between_snapshots(self):
+        reg = metrics.MetricsRegistry()
+        outcomes = reg.counter(
+            "requests_total", "", ("app", "deployment", "outcome")
+        )
+        e2e = reg.histogram(
+            "request_e2e_seconds", "", ("app", "deployment", "method"),
+            buckets=(0.1, 0.5),
+        )
+        sampler = RegistrySampler(registry=reg)
+        assert sampler.sample() is None  # baseline
+        outcomes.labels("a", "d", "ok").inc(5)
+        outcomes.labels("a", "d", "transport_error").inc(2)
+        e2e.labels("a", "d", "infer").observe(0.05)
+        e2e.labels("a", "d", "infer").observe(0.3)
+        snap = sampler.sample()
+        d = snap["deployments"]["a/d"]
+        assert d["requests"] == 7
+        assert d["errors"] == 2
+        assert d["latency_buckets"] == {"0.1": 1, "0.5": 2}
+        # second sample with no traffic: nothing to report
+        assert sampler.sample() is None
+        # snapshots are stamped with the process identity (the
+        # controller drops same-process pushes by it)
+        assert snap["source_id"]
+
+    async def test_live_registry_roundtrip_agrees_within_bucket_error(self):
+        """Acceptance: drive a real deployment, tick telemetry, and the
+        reconstructed rate/p99 agree with the live registry within
+        quantile-bucket error."""
+        import asyncio
+
+        class App:
+            async def infer(self):
+                await asyncio.sleep(0.012)
+                return 1
+
+        controller = ServeController(
+            ClusterState(TpuTopology(chips=(), n_hosts=1, platform="cpu")),
+            health_check_period=3600,
+        )
+        try:
+            controller.telemetry = TelemetryStore(resolutions=[(0.5, 240)])
+            await controller.deploy(
+                "telem-app",
+                [DeploymentSpec(name="entry", instance_factory=App)],
+            )
+            handle = controller.get_handle("telem-app")
+            controller.telemetry_tick()   # baseline
+            n = 12
+            for _ in range(n):
+                await handle.call("infer")
+            controller.telemetry_tick()
+
+            telem = controller.get_telemetry(app="telem-app")
+            series = telem["deployments"]["telem-app/entry"]
+            total = sum(
+                p["value"] * 0.5
+                for p in series["request_rate"]
+                if p["value"]
+            )
+            assert total == pytest.approx(n, abs=0.5)
+
+            # live registry truth
+            snap = metrics.collect()
+            live = next(
+                s
+                for s in snap["request_e2e_seconds"]["series"]
+                if s["labels"]["app"] == "telem-app"
+            )
+            stored_p99 = max(
+                p["value"]
+                for p in series["latency_p99"]
+                if p["value"] is not None
+            )
+            assert stored_p99 == live["p99"]  # same bucket edge
+        finally:
+            await controller.stop()
+
+    async def test_get_telemetry_validates_series_names(self):
+        controller = ServeController(
+            ClusterState(TpuTopology(chips=(), n_hosts=1, platform="cpu")),
+            health_check_period=3600,
+        )
+        try:
+            with pytest.raises(ValueError, match="unknown telemetry series"):
+                controller.get_telemetry(series="nope")
+            assert controller.get_telemetry(series="request_rate") is not None
+        finally:
+            await controller.stop()
