@@ -71,6 +71,11 @@ class Pipeline:
         # legacy single-device behavior.
         self.devices = list(devices) if devices else None
         self.package_path = Path(package_path)
+        # cold-start accounting: how this pipeline's weights landed
+        # (eager vs streamed, seconds, bytes) — Replica.describe reads
+        # it through RuntimeDeployment.cold_start_info
+        self.load_info: dict = {}
+        self._weight_loader = None
         rdf_path = self.package_path / "rdf.yaml"
         self.rdf = load_model_rdf(rdf_path)
         self.weights_format, self.weights_entry = self._select_weights(
@@ -117,13 +122,37 @@ class Pipeline:
     def _build_backend(self, config: EngineConfig):
         entry = self.weights_entry
         if self.weights_format == "jax_params":
+            import os as _os
+            import time as _time
+
             from bioengine_tpu.models.registry import get_model
 
             from bioengine_tpu.runtime.convert import load_params_npz
+            from bioengine_tpu.runtime.weight_stream import (
+                StreamedWeightLoader,
+                load_manifest,
+                skeleton_from_manifest,
+            )
 
             arch = entry.get("architecture") or {}
             model = get_model(arch.get("name", ""), **(arch.get("kwargs") or {}))
-            params = load_params_npz(str(self._resolve(entry["source"])))
+            source = self._resolve(entry["source"])
+            # streamed path: a key→shape manifest next to the npz lets
+            # the engine build (and compile/warm) against a zero-filled
+            # skeleton immediately while the real bytes stream in
+            # background threads; prediction gates on residency so the
+            # output is bit-identical to an eager load. No manifest (or
+            # BIOENGINE_WEIGHT_STREAMING=0) → the eager path, unchanged.
+            manifest = (
+                load_manifest(source)
+                if _os.environ.get("BIOENGINE_WEIGHT_STREAMING", "1") != "0"
+                else None
+            )
+            t_load = _time.perf_counter()
+            if manifest is not None:
+                params = skeleton_from_manifest(manifest)
+            else:
+                params = load_params_npz(str(source))
             engine = InferenceEngine(
                 model_id=self._model_key(),
                 apply_fn=lambda prm, x: model.apply({"params": prm}, x),
@@ -133,6 +162,26 @@ class Pipeline:
                 config=config,
                 devices=self.devices,
             )
+            if manifest is not None:
+                engine.begin_param_streaming()
+                self._weight_loader = StreamedWeightLoader(
+                    source,
+                    manifest,
+                    on_complete=engine.complete_param_streaming,
+                    on_error=engine.fail_param_streaming,
+                    model_id=self._model_key(),
+                ).start()
+                self.load_info = {
+                    "streamed": True,
+                    "manifest_keys": len(manifest),
+                }
+            else:
+                self.load_info = {
+                    "streamed": False,
+                    "weights_seconds": round(
+                        _time.perf_counter() - t_load, 4
+                    ),
+                }
             return "xla", engine
 
         from bioengine_tpu.runtime.torch_fallback import TorchFallbackRunner
@@ -249,6 +298,26 @@ class Pipeline:
         controller's get_app_status."""
         stats = getattr(self.engine, "pipeline_stats", None)
         return stats.as_dict() if stats is not None else {}
+
+    def cold_start_info(self) -> dict:
+        """This pipeline's cold-start breakdown: how the weights landed
+        (eager vs streamed, seconds, bytes) and what its compiles cost
+        (real XLA seconds vs persistent/tier cache hits)."""
+        info = dict(self.load_info)
+        if self._weight_loader is not None:
+            st = self._weight_loader.stats()
+            info["weights_seconds"] = st["seconds"]
+            info["bytes_loaded"] = st["bytes_loaded"]
+            info["stream_done"] = st["done"]
+            if st["error"]:
+                info["stream_error"] = st["error"]
+        describe = getattr(self.engine, "describe", None)
+        if callable(describe):
+            progs = describe().get("programs", {})
+            info["compile_seconds"] = progs.get("real_compile_seconds")
+            info["persistent_cache_hits"] = progs.get("persistent_hits")
+            info["real_compiles"] = progs.get("real_compiles")
+        return info
 
     def close(self) -> None:
         close = getattr(self.engine, "close", None)
@@ -412,6 +481,17 @@ class RuntimeDeployment:
         get_app_status)."""
         return {
             self._status_key(key, p): p.pipeline_stats()
+            for key, p in self._pipelines.items()
+            if p.backend == "xla"
+        }
+
+    def cold_start_info(self) -> dict:
+        """Per-pipeline cold-start breakdown (weights load path +
+        compile cost), keyed like pipeline_stats/mesh_info so the
+        controller can join all three views — picked up by
+        Replica.describe as the ``cold_start.pipelines`` section."""
+        return {
+            self._status_key(key, p): p.cold_start_info()
             for key, p in self._pipelines.items()
             if p.backend == "xla"
         }

@@ -60,9 +60,10 @@ Env overrides:
   BENCH_TIMEOUT=N       per-attempt cap, also capped by the deadline
   BENCH_STALL=N         kill an attempt after N s with no stage output
                         (mid-stage wedge detector; default 240)
-  BENCH_CONFIGS=a,b,c   subset of vit,unet,sharded_serving,cellpose,
-                        search,observability_overhead,scheduler_goodput,
-                        flash,unet3d,ivfpq,pqflat,rpc_transport
+  BENCH_CONFIGS=a,b,c   subset of vit,unet,sharded_serving,cold_start,
+                        cellpose,search,observability_overhead,
+                        scheduler_goodput,flash,unet3d,ivfpq,pqflat,
+                        rpc_transport
   BENCH_PROBE_CADENCE=N seconds between tunnel probes while wedged
                         (default 60)
   BENCH_REPS=N          timed reps per stage (default 2, best-of)
@@ -88,6 +89,7 @@ STAGE_COSTS = {
     "vit": 60,
     "unet": 45,
     "sharded_serving": 50,
+    "cold_start": 50,
     "pipeline_overlap": 60,
     "cellpose": 60,
     "search": 40,
@@ -382,6 +384,262 @@ def sharded_worker_main() -> int:
         jax.config.update("jax_platforms", "cpu")
     print(json.dumps(_sharded_serving_measure(cpu)), flush=True)
     return 0
+
+
+# ---------------------------------------------------------------------------
+# cold_start stage: replica time-to-first-request, cold vs warm-cache vs
+# warm-pool, on the model-runner jax_params path.
+# ---------------------------------------------------------------------------
+
+
+def _make_cold_start_package(root: str) -> str:
+    """A tiny self-contained jax_params model package (model-runner
+    layout: rdf.yaml + weights.npz + key→shape streaming manifest) the
+    cold-start legs load — same shape as the real Zoo packages, small
+    enough that COMPILE dominates, exactly like production."""
+    from pathlib import Path
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import yaml
+
+    from bioengine_tpu.models.unet import UNet2D
+    from bioengine_tpu.runtime.convert import flatten_params, save_params_npz
+    from bioengine_tpu.runtime.weight_stream import write_manifest
+
+    d = Path(root) / "coldstart-unet"
+    d.mkdir(parents=True, exist_ok=True)
+    model = UNet2D(features=(8, 16), out_channels=1)
+    x = np.random.default_rng(0).normal(size=(1, 64, 64, 1)).astype(np.float32)
+    params = model.init(jax.random.key(0), jnp.asarray(x))["params"]
+    save_params_npz(str(d / "weights.npz"), params)
+    write_manifest(d / "weights.npz", flatten_params(params))
+    np.save(d / "test_input.npy", x)
+    (d / "rdf.yaml").write_text(
+        yaml.safe_dump(
+            {
+                "type": "model",
+                "name": "ColdStart UNet",
+                "description": "cold-start bench model",
+                "inputs": [{"name": "input0", "axes": "byxc"}],
+                "outputs": [{"name": "output0", "axes": "byxc"}],
+                "test_inputs": ["test_input.npy"],
+                "documentation": "README.md",
+                "weights": {
+                    "jax_params": {
+                        "source": "weights.npz",
+                        "architecture": {
+                            "name": "unet2d",
+                            "kwargs": {"features": [8, 16], "out_channels": 1},
+                        },
+                    }
+                },
+            }
+        )
+    )
+    (d / "README.md").write_text("cold-start bench model")
+    return str(d)
+
+
+def _load_model_runner_module():
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "apps",
+        "model-runner",
+        "runtime_deployment.py",
+    )
+    spec = importlib.util.spec_from_file_location("bench_mr_rt", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def cold_start_worker_main() -> int:
+    """``bench.py --cold-start-worker``: ONE replica cold start in its
+    own interpreter (the only honest way to measure it — an in-process
+    leg would hit the in-memory program cache). Builds the model-runner
+    Pipeline against $BENCH_COLDSTART_PACKAGE with the persistent XLA
+    cache at $BENCH_COLDSTART_CACHE and reports the TTFR breakdown as
+    one JSON line."""
+    cpu = os.environ.get("BENCH_PLATFORM", "").lower() == "cpu"
+    if cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from bioengine_tpu.utils.compile_cache import (
+        enable_persistent_compilation_cache,
+    )
+
+    package = os.environ["BENCH_COLDSTART_PACKAGE"]
+    enable_persistent_compilation_cache(os.environ["BENCH_COLDSTART_CACHE"])
+    rt = _load_model_runner_module()
+    x = np.load(os.path.join(package, "test_input.npy"))
+    t_start = time.perf_counter()
+    pipeline = rt.Pipeline(package)
+    build_s = time.perf_counter() - t_start
+    t1 = time.perf_counter()
+    pipeline.predict(x)
+    first_request_s = time.perf_counter() - t1
+    ttfr_s = time.perf_counter() - t_start
+    info = pipeline.cold_start_info()
+    print(
+        json.dumps(
+            {
+                "ttfr_s": round(ttfr_s, 4),
+                "build_s": round(build_s, 4),
+                "first_request_s": round(first_request_s, 4),
+                "weights_s": info.get("weights_seconds"),
+                "compile_s": info.get("compile_seconds"),
+                "streamed": info.get("streamed"),
+                "persistent_cache_hits": info.get("persistent_cache_hits"),
+                "real_compiles": info.get("real_compiles"),
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+def _cold_start_warm_pool_leg(package: str) -> dict:
+    """The warm-pool leg runs in-process by design: promotion IS an
+    in-process list move, and the promoted standby's programs live in
+    its own warm program cache. Measures promote → first request on a
+    controller-managed pool of 1."""
+    import asyncio
+
+    import numpy as np
+
+    from bioengine_tpu.cluster.state import ClusterState
+    from bioengine_tpu.serving import (
+        DeploymentSpec,
+        ServeController,
+        WarmPoolConfig,
+    )
+
+    rt = _load_model_runner_module()
+    x = np.load(os.path.join(package, "test_input.npy"))
+
+    class ColdStartApp:
+        def __init__(self):
+            self.pipeline = None
+
+        async def async_init(self):
+            self.pipeline = await asyncio.to_thread(rt.Pipeline, package)
+
+        async def test_deployment(self):
+            # a standby is warm BECAUSE its self-test compiled the
+            # serving programs — exactly what production app tests do
+            await asyncio.to_thread(self.pipeline.predict, x)
+
+        async def predict(self):
+            out = await asyncio.to_thread(self.pipeline.predict, x)
+            return list(next(iter(out.values())).shape)
+
+        def close(self):
+            if self.pipeline is not None:
+                self.pipeline.close()
+
+    async def run() -> dict:
+        controller = ServeController(ClusterState(), health_check_period=3600)
+        spec = DeploymentSpec(
+            name="entry",
+            instance_factory=ColdStartApp,
+            num_replicas=1,
+            max_replicas=4,
+            autoscale=False,
+            warm_pool=WarmPoolConfig(size=1, refill=False),
+        )
+        app = await controller.deploy("coldstart-bench", [spec])
+        pool = controller._warm_pools[("coldstart-bench", "entry")]
+        try:
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if pool.standbys and all(
+                    r.state.value == "HEALTHY" for r in pool.standbys
+                ):
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise RuntimeError("warm standby never became HEALTHY")
+            t0 = time.perf_counter()
+            promoted = await controller._add_replica(app, spec)
+            promote_s = time.perf_counter() - t0
+            await promoted.call("predict")
+            ttfr_s = time.perf_counter() - t0
+            return {
+                "ttfr_s": round(ttfr_s, 4),
+                "promote_s": round(promote_s, 4),
+                "first_request_s": round(ttfr_s - promote_s, 4),
+                "promoted_from_warm_pool": bool(
+                    promoted.promoted_from_warm_pool
+                ),
+                "promotions": pool.promotions,
+            }
+        finally:
+            await controller.stop()
+
+    return asyncio.run(run())
+
+
+def _bench_cold_start(cpu: bool) -> dict:  # noqa: ARG001 — legs self-configure
+    """Replica TTFR on the model-runner path, three legs: COLD (fresh
+    process, empty compile cache), WARM-CACHE (fresh process, the cache
+    the cold leg just populated — the shared-tier experience of a new
+    host after ``program.cache_fetch``), WARM-POOL (standby promotion).
+    The acceptance number is speedup_warm_pool: the warm path must beat
+    the cold path by ≥10x."""
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="bench-coldstart-")
+    package = _make_cold_start_package(root)
+    cache_dir = os.path.join(root, "xla-cache")
+
+    def subprocess_leg() -> dict:
+        env = dict(os.environ)
+        env["BENCH_COLDSTART_PACKAGE"] = package
+        env["BENCH_COLDSTART_CACHE"] = cache_dir
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.abspath(__file__),
+                "--cold-start-worker",
+            ],
+            capture_output=True,
+            text=True,
+            env=env,
+            timeout=float(
+                os.environ.get("BENCH_COLDSTART_WORKER_TIMEOUT", "180")
+            ),
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"cold-start worker rc={proc.returncode}: "
+                f"{proc.stderr[-500:]}"
+            )
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    cold = subprocess_leg()
+    warm_cache = subprocess_leg()  # same dir, populated by the cold leg
+    warm_pool = _cold_start_warm_pool_leg(package)
+    return {
+        "cold": cold,
+        "warm_cache": warm_cache,
+        "warm_pool": warm_pool,
+        "speedup_warm_cache": round(
+            cold["ttfr_s"] / max(warm_cache["ttfr_s"], 1e-9), 2
+        ),
+        "speedup_warm_pool": round(
+            cold["ttfr_s"] / max(warm_pool["ttfr_s"], 1e-9), 2
+        ),
+        "warm_cache_hit_observed": bool(
+            (warm_cache.get("persistent_cache_hits") or 0) > 0
+        ),
+    }
 
 
 def _bench_pipeline_overlap(cpu: bool) -> dict:
@@ -1526,6 +1784,7 @@ def worker_main() -> int:
         "vit": _bench_vit,
         "unet": _bench_unet,
         "sharded_serving": _bench_sharded_serving,
+        "cold_start": _bench_cold_start,
         "pipeline_overlap": _bench_pipeline_overlap,
         "unet3d": _bench_unet3d,
         "cellpose": _bench_cellpose,
@@ -1841,6 +2100,7 @@ def _final_json(shared: _Shared, deadline_hit: bool) -> str:
             "probe": shared.stages.get("probe"),
             "unet256": shared.stages.get("unet"),
             "sharded_serving": shared.stages.get("sharded_serving"),
+            "cold_start": shared.stages.get("cold_start"),
             "pipeline_overlap": shared.stages.get("pipeline_overlap"),
             "unet3d": shared.stages.get("unet3d"),
             "search_latency": shared.stages.get("search"),
@@ -2030,6 +2290,8 @@ def main() -> int:
         return worker_main()
     if "--sharded-worker" in sys.argv:
         return sharded_worker_main()
+    if "--cold-start-worker" in sys.argv:
+        return cold_start_worker_main()
     if "--compare" in sys.argv:
         return compare_main(sys.argv)
 

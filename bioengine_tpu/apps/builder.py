@@ -31,6 +31,7 @@ from bioengine_tpu.rpc.schema import is_schema_method
 from bioengine_tpu.serving.controller import DeploymentSpec
 from bioengine_tpu.serving.scheduler import SchedulingConfig
 from bioengine_tpu.serving.slo import SLOConfig
+from bioengine_tpu.serving.warm_pool import WarmPoolConfig
 from bioengine_tpu.utils.logger import create_logger
 
 # env var override mirroring the reference's local-artifact escape hatch
@@ -360,6 +361,7 @@ class AppBuilder:
             batching = dict(cfg.get("batching") or {})
             scheduling_cfg = cfg.get("scheduling")
             slo_cfg = cfg.get("slo")
+            warm_pool_cfg = cfg.get("warm_pool")
             try:
                 spec_max_batch = (
                     int(batching["max_batch"])
@@ -379,12 +381,17 @@ class AppBuilder:
                 slo = (
                     SLOConfig.from_config(dict(slo_cfg)) if slo_cfg else None
                 )
+                warm_pool = (
+                    WarmPoolConfig.from_config(dict(warm_pool_cfg))
+                    if warm_pool_cfg
+                    else None
+                )
             except (TypeError, ValueError) as e:
                 # every config mistake on this path fails TYPED with the
                 # deployment named — never a raw traceback
                 raise AppBuildError(
-                    f"invalid batching/scheduling/slo config for deployment "
-                    f"'{ref.file_stem}': {e}"
+                    f"invalid batching/scheduling/warm_pool/slo config for "
+                    f"deployment '{ref.file_stem}': {e}"
                 ) from e
             specs.append(
                 DeploymentSpec(
@@ -400,6 +407,7 @@ class AppBuilder:
                     max_wait_ms=spec_max_wait_ms,
                     scheduling=scheduling,
                     slo=slo,
+                    warm_pool=warm_pool,
                     remote_payload={
                         **base_payload,
                         "deployment": ref.file_stem,

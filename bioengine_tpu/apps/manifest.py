@@ -31,7 +31,10 @@ _DEPLOYMENT_RE = re.compile(r"^([A-Za-z_][\w\-/]*):([A-Za-z_]\w*)$")
 # serving.scheduler.SchedulingConfig.from_config at build time);
 # ``slo`` declares the deployment's service objectives (validated in
 # depth by serving.slo.SLOConfig.from_config at build time — latency
-# objective + percentile, availability target, window).
+# objective + percentile, availability target, window); ``warm_pool``
+# keeps N pre-started standby replicas that absorb scale-up and
+# preemption by promotion (validated in depth by
+# serving.warm_pool.WarmPoolConfig.from_config at build time).
 _BATCHING_KEYS = {"max_batch", "max_wait_ms"}
 
 
@@ -119,6 +122,12 @@ def validate_manifest(data: dict[str, Any]) -> AppManifest:
             raise ManifestError(
                 f"deployment_config.{dep_name}.slo must be a "
                 f"mapping, got {type(slo).__name__}"
+            )
+        warm_pool = cfg.get("warm_pool")
+        if warm_pool is not None and not isinstance(warm_pool, dict):
+            raise ManifestError(
+                f"deployment_config.{dep_name}.warm_pool must be a "
+                f"mapping, got {type(warm_pool).__name__}"
             )
     return AppManifest(
         name=str(data["name"]),

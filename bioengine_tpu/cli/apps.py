@@ -129,6 +129,38 @@ def list_command(server_url, token):
     emit(result, human="\n".join(lines) or "(no apps)")
 
 
+def _cold_start_lines(status: dict) -> list[str]:
+    """One line per deployment with a cold_start section: warm-pool
+    occupancy/promotions, last-replica TTFR, and the compile-tier hit
+    rate — the at-a-glance view of whether scale-ups are warm."""
+    lines: list[str] = []
+    apps = status if "deployments" not in status else {"": status}
+    for app_id, st in apps.items():
+        for name, dep in (st.get("deployments") or {}).items():
+            cold = dep.get("cold_start") or {}
+            pool = cold.get("warm_pool")
+            ttfr = (cold.get("last_replica_ttfr") or {}).get("ttfr_seconds")
+            compile_ = cold.get("compile") or {}
+            parts = [f"{app_id + '/' if app_id else ''}{name}:"]
+            parts.append(
+                f"warm_pool {pool['occupancy']}/{pool['target']} "
+                f"(promotions={pool['promotions']})"
+                if pool
+                else "warm_pool off"
+            )
+            parts.append(
+                f"last_ttfr={ttfr:.3f}s" if ttfr is not None else "last_ttfr=-"
+            )
+            hr = compile_.get("hit_rate")
+            parts.append(
+                f"compile_hits={compile_.get('persistent_cache_hits', 0)}"
+                f"/{(compile_.get('persistent_cache_hits', 0) or 0) + (compile_.get('real_compiles', 0) or 0)}"
+                + (f" ({hr:.0%})" if hr is not None else "")
+            )
+            lines.append("  ".join(parts))
+    return lines
+
+
 @apps_group.command("status")
 @click.argument("app_id", required=False)
 @server_options
@@ -137,7 +169,11 @@ def status_command(app_id, server_url, token):
     result = run_async(
         with_worker(server_url, token, lambda w: w.get_app_status(app_id=app_id))
     )
-    emit(result, human=json.dumps(result, indent=2, default=str))
+    cold = _cold_start_lines(result if isinstance(result, dict) else {})
+    human = json.dumps(result, indent=2, default=str)
+    if cold:
+        human = "cold-start:\n" + "\n".join(cold) + "\n\n" + human
+    emit(result, human=human)
 
 
 @apps_group.command("logs")

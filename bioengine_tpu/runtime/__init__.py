@@ -15,6 +15,12 @@ from bioengine_tpu.runtime.program_cache import (
     CompiledProgramCache,
     default_program_cache,
 )
+from bioengine_tpu.runtime.weight_stream import (
+    StreamedWeightLoader,
+    load_manifest,
+    skeleton_from_manifest,
+    write_manifest,
+)
 
 __all__ = [
     "bucket_shape",
@@ -29,4 +35,8 @@ __all__ = [
     "run_pipeline",
     "CompiledProgramCache",
     "default_program_cache",
+    "StreamedWeightLoader",
+    "load_manifest",
+    "skeleton_from_manifest",
+    "write_manifest",
 ]

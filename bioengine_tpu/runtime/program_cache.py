@@ -19,6 +19,23 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Hashable, Optional
 
 from bioengine_tpu.utils import flight, metrics
+from bioengine_tpu.utils import compile_cache as _compile_cache
+
+
+def _persistent_cache_on() -> bool:
+    return _compile_cache.enabled_dir() is not None
+
+
+def _hit_threshold_s() -> float:
+    """Sanity bound on the hit verdict: even when build() wrote no new
+    persistent-cache entry, a build slower than this is reported as a
+    real compile. The primary signal is the entry write (a real compile
+    persists a new file, a disk/tier hit writes nothing), so this only
+    needs to exclude pathological cases — default 5 s sits far under a
+    TPU compile (20-40 s) and far over a disk hit (<1 s)."""
+    import os
+
+    return float(os.environ.get("BIOENGINE_COMPILE_HIT_THRESHOLD_S", "5"))
 
 
 @dataclass
@@ -26,10 +43,17 @@ class CacheStats:
     hits: int = 0
     misses: int = 0
     evictions: int = 0
+    # misses whose build() came back near-instantly while the jax
+    # persistent compilation cache was enabled: a disk/tier hit, not a
+    # real compile — without the tag a warm replica's "compile" and a
+    # cold one's are indistinguishable in describe()/flight
+    persistent_hits: int = 0
     # per-key compile time for LIVE entries only — evicted programs'
     # entries are dropped with them (a long-lived replica cycling
     # through shapes would otherwise grow this dict forever)
     compile_seconds: dict = field(default_factory=dict)
+    # per-key cache_hit verdict, same lifecycle as compile_seconds
+    cache_hit: dict = field(default_factory=dict)
     # lifetime total, survives evictions
     cumulative_compile_seconds: float = 0.0
 
@@ -39,6 +63,7 @@ class CacheStats:
             "hits": self.hits,
             "misses": self.misses,
             "evictions": self.evictions,
+            "persistent_hits": self.persistent_hits,
             "hit_rate": self.hits / total if total else 0.0,
             "total_compile_seconds": self.cumulative_compile_seconds,
             "live_compile_seconds": sum(self.compile_seconds.values()),
@@ -50,7 +75,7 @@ def _collect_program_caches(instances: list) -> list:
     compile time is the cold-start cost (ROADMAP item 3) and the reason
     a request's p99 suddenly grows a 30 s tail — it belongs on the
     dashboard next to the latency histograms it explains."""
-    hits = misses = evictions = 0
+    hits = misses = evictions = persistent = 0
     compile_s = 0.0
     live = 0
     for c in instances:
@@ -58,6 +83,7 @@ def _collect_program_caches(instances: list) -> list:
         hits += s.hits
         misses += s.misses
         evictions += s.evictions
+        persistent += s.persistent_hits
         compile_s += s.cumulative_compile_seconds
         live += len(c)
     return [
@@ -77,6 +103,12 @@ def _collect_program_caches(instances: list) -> list:
             "program_cache_compile_seconds_total", round(compile_s, 6),
             kind="counter",
             help="lifetime XLA compile seconds across caches",
+        ),
+        metrics.Sample(
+            "program_cache_persistent_hits_total", persistent,
+            kind="counter",
+            help="misses satisfied by the persistent/tier cache "
+            "(near-zero compile), not a real XLA compile",
         ),
         metrics.Sample(
             "program_cache_live_programs", live,
@@ -120,23 +152,50 @@ class CompiledProgramCache:
                     break
             ev.wait()
         try:
+            cache_dir = _compile_cache.enabled_dir()
+            before = (
+                set(_compile_cache.list_entries(cache_dir))
+                if cache_dir
+                else None
+            )
             t0 = time.perf_counter()
             program = build()
             dt = time.perf_counter() - t0
+            # Tag disk/tier hits apart from real compiles. Primary
+            # signal: a REAL compile persists a new cache entry while a
+            # hit writes nothing (wall time alone can't separate them —
+            # a loaded CPU traces slower than a TPU disk-reads). The
+            # env-tunable threshold is only a sanity bound on top;
+            # foreign entries written concurrently by another engine
+            # can at worst demote a hit to "real" (conservative).
+            if before is not None:
+                wrote_new = bool(
+                    set(_compile_cache.list_entries(cache_dir)) - before
+                )
+                cache_hit = not wrote_new and dt < _hit_threshold_s()
+            else:
+                cache_hit = False
             evicted = []
             with self._lock:
                 self.stats.misses += 1
+                if cache_hit:
+                    self.stats.persistent_hits += 1
                 self.stats.compile_seconds[str(key)] = dt
+                self.stats.cache_hit[str(key)] = cache_hit
                 self.stats.cumulative_compile_seconds += dt
                 self._programs[key] = program
                 self._programs.move_to_end(key)
                 while len(self._programs) > self.max_programs:
                     victim, _ = self._programs.popitem(last=False)
                     self.stats.compile_seconds.pop(str(victim), None)
+                    self.stats.cache_hit.pop(str(victim), None)
                     self.stats.evictions += 1
                     evicted.append(victim)
             flight.record(
-                "program.compile", key=str(key), seconds=round(dt, 3)
+                "program.compile",
+                key=str(key),
+                seconds=round(dt, 3),
+                cache_hit=cache_hit,
             )
             for victim in evicted:
                 flight.record("program.evict", key=str(victim))
@@ -151,6 +210,19 @@ class CompiledProgramCache:
         a compile on the dispatch thread inserts/evicts."""
         with self._lock:
             return dict(self.stats.compile_seconds)
+
+    def compile_info_snapshot(self) -> dict:
+        """Per-key ``{"seconds": s, "cache_hit": bool}`` under the
+        cache lock — the describe() view that tells a tier/disk hit
+        apart from a real compile."""
+        with self._lock:
+            return {
+                k: {
+                    "seconds": v,
+                    "cache_hit": bool(self.stats.cache_hit.get(k, False)),
+                }
+                for k, v in self.stats.compile_seconds.items()
+            }
 
     def stats_dict(self) -> dict:
         """``stats.as_dict()`` under the cache lock (it sums the live
@@ -173,6 +245,7 @@ class CompiledProgramCache:
             for k in victims:
                 del self._programs[k]
                 self.stats.compile_seconds.pop(str(k), None)
+                self.stats.cache_hit.pop(str(k), None)
             self.stats.evictions += len(victims)
         for k in victims:
             flight.record("program.evict", key=str(k))

@@ -1,0 +1,277 @@
+"""Streamed checkpoint loading from key→shape manifests.
+
+Cold-starting a replica serializes two expensive steps: read the whole
+checkpoint, then compile the model. The committed key→shape manifests
+(tests/fixtures_manifest_*.json; any ``<weights>.manifest.json`` file
+shipped next to a package's npz) make the *layout* known without
+reading a single weight byte — so the two steps can overlap:
+
+1. :func:`skeleton_from_manifest` builds a zero-filled params pytree of
+   the exact shapes/dtypes the checkpoint will have. The engine is
+   constructed from it immediately and starts compiling/warming its
+   programs (same shapes → same executables, valid after the swap).
+2. :class:`StreamedWeightLoader` streams the real weight groups
+   concurrently in the background (the npz container is a zip — each
+   member is independently readable, so groups load in parallel worker
+   threads without staging the whole archive).
+3. The engine's prediction path gates on ``complete_param_streaming``,
+   so the first request blocks only until the bytes land — never runs
+   against the skeleton — and TTFR becomes ~max(compile, load) instead
+   of load + compile.
+
+No manifest → the caller falls back to the eager load path, byte-for-
+byte unchanged. A manifest/checkpoint shape mismatch fails the load
+loudly (the replica start error names the key) instead of serving a
+silently mis-shaped model.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+from typing import Any, Callable, Mapping, Optional
+
+import numpy as np
+
+from bioengine_tpu.runtime.convert import unflatten_params
+from bioengine_tpu.utils import flight
+from bioengine_tpu.utils.logger import create_logger
+
+logger = create_logger("weight_stream", log_file="off")
+
+MANIFEST_SUFFIX = ".manifest.json"
+
+
+def manifest_path_for(weights_path: str | Path) -> Path:
+    """The conventional manifest location: ``<weights>.manifest.json``
+    next to the checkpoint (``weights.npz`` → ``weights.npz.manifest.json``)."""
+    p = Path(weights_path)
+    return p.with_name(p.name + MANIFEST_SUFFIX)
+
+
+def load_manifest(weights_path: str | Path) -> Optional[dict[str, dict]]:
+    """Read the key→{shape, dtype} manifest for ``weights_path``, or
+    None when absent/unreadable (the caller then loads eagerly — a
+    missing manifest is the documented fallback, never an error).
+
+    Accepts both forms: ``{"a/b": [3, 3]}`` (legacy shape-only, dtype
+    assumed float32 — the committed PR 3 checkpoint manifests) and
+    ``{"a/b": {"shape": [3, 3], "dtype": "bfloat16"}}``. Normalized to
+    the dict form — the skeleton must match the checkpoint's dtypes or
+    the warm-up executables compile for the wrong types and the first
+    request retraces from scratch."""
+    p = manifest_path_for(weights_path)
+    if not p.is_file():
+        return None
+    try:
+        data = json.loads(p.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        logger.warning(f"manifest {p} unreadable ({e}); eager load")
+        return None
+    if not isinstance(data, dict) or not data:
+        return None
+    try:
+        out: dict[str, dict] = {}
+        for k, v in data.items():
+            if isinstance(v, dict):
+                shape = [int(d) for d in v["shape"]]
+                dtype = str(np.dtype(v.get("dtype", "float32")))
+            else:
+                shape = [int(d) for d in v]
+                dtype = "float32"
+            out[str(k)] = {"shape": shape, "dtype": dtype}
+        return out
+    except (TypeError, ValueError, KeyError):
+        logger.warning(f"manifest {p} malformed; eager load")
+        return None
+
+
+def write_manifest(
+    weights_path: str | Path, params_flat: Mapping[str, np.ndarray]
+) -> Path:
+    """Write the key→{shape, dtype} manifest for a flat params mapping
+    (the publishing half — model conversion/CI fixtures call this so
+    every shipped checkpoint can stream)."""
+    p = manifest_path_for(weights_path)
+    p.write_text(
+        json.dumps(
+            {
+                k: {
+                    "shape": list(np.asarray(v).shape),
+                    "dtype": str(np.asarray(v).dtype),
+                }
+                for k, v in params_flat.items()
+            },
+            indent=1,
+            sort_keys=True,
+        )
+        + "\n"
+    )
+    return p
+
+
+def skeleton_from_manifest(manifest: Mapping[str, dict]) -> dict[str, Any]:
+    """Zero-filled params pytree with the manifest's exact layout AND
+    dtypes — enough for the engine to trace, compile, and warm every
+    program the real checkpoint will run (a wrong-dtype skeleton would
+    warm executables the real params then silently retrace past)."""
+    return unflatten_params(
+        {
+            k: np.zeros(tuple(e["shape"]), np.dtype(e["dtype"]))
+            for k, e in manifest.items()
+        }
+    )
+
+
+def group_keys(manifest: Mapping[str, list[int]]) -> dict[str, list[str]]:
+    """Manifest keys bucketed by top-level pytree group (the ``a`` of
+    ``a/b/c``) — the unit of concurrent streaming."""
+    groups: dict[str, list[str]] = {}
+    for key in manifest:
+        groups.setdefault(key.split("/", 1)[0], []).append(key)
+    return groups
+
+
+class StreamedWeightLoader:
+    """Load an npz checkpoint group-by-group on background threads.
+
+    ``on_complete(params)`` fires exactly once with the full pytree
+    (shape-validated against the manifest); ``on_error(exc)`` fires on
+    the first failure. Stats (groups/bytes/seconds) feed the replica's
+    TTFR breakdown.
+    """
+
+    def __init__(
+        self,
+        npz_path: str | Path,
+        manifest: Mapping[str, list[int]],
+        on_complete: Callable[[dict], None],
+        on_error: Optional[Callable[[BaseException], None]] = None,
+        max_workers: int = 4,
+        model_id: str = "?",
+    ):
+        self.npz_path = str(npz_path)
+        self.manifest = dict(manifest)
+        self.on_complete = on_complete
+        self.on_error = on_error
+        self.max_workers = max(1, int(max_workers))
+        self.model_id = model_id
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.groups_loaded = 0
+        self.bytes_loaded = 0
+        self.seconds: float = 0.0
+        self._started_at: Optional[float] = None
+
+    def start(self) -> "StreamedWeightLoader":
+        self._started_at = time.perf_counter()
+        t = threading.Thread(
+            target=self._run, name=f"weight-stream-{self.model_id}",
+            daemon=True,
+        )
+        t.start()
+        return self
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.done.wait(timeout)
+
+    # ---- internals ----------------------------------------------------------
+
+    def _load_group(self, keys: list[str]) -> dict[str, np.ndarray]:
+        # one npz handle per task: the zip central directory is cheap
+        # to re-read and zipfile handles aren't safe to share across
+        # reader threads
+        out: dict[str, np.ndarray] = {}
+        with np.load(self.npz_path) as data:
+            for key in keys:
+                if key not in data.files:
+                    raise KeyError(
+                        f"manifest key '{key}' missing from "
+                        f"{self.npz_path}"
+                    )
+                arr = data[key]
+                entry = self.manifest[key]
+                want = tuple(entry["shape"])
+                if tuple(arr.shape) != want:
+                    raise ValueError(
+                        f"'{key}': checkpoint shape {tuple(arr.shape)} != "
+                        f"manifest shape {want}"
+                    )
+                want_dtype = np.dtype(entry["dtype"])
+                if arr.dtype != want_dtype:
+                    # the skeleton compiled for the manifest dtype — a
+                    # mismatched checkpoint would retrace every warmed
+                    # program AND may not be the model the caller pinned
+                    raise ValueError(
+                        f"'{key}': checkpoint dtype {arr.dtype} != "
+                        f"manifest dtype {want_dtype}"
+                    )
+                out[key] = arr
+        return out
+
+    def _run(self) -> None:
+        try:
+            groups = group_keys(self.manifest)
+            flat: dict[str, np.ndarray] = {}
+            with ThreadPoolExecutor(
+                max_workers=min(self.max_workers, max(1, len(groups))),
+                thread_name_prefix=f"wstream-{self.model_id}",
+            ) as pool:
+                futures = {
+                    pool.submit(self._load_group, keys): name
+                    for name, keys in groups.items()
+                }
+                for fut, name in futures.items():
+                    loaded = fut.result()
+                    flat.update(loaded)
+                    self.groups_loaded += 1
+                    self.bytes_loaded += sum(a.nbytes for a in loaded.values())
+            # checkpoint keys the manifest doesn't know would silently
+            # vanish from the model — refuse, like convert's strict mode
+            with np.load(self.npz_path) as data:
+                extra = sorted(set(data.files) - set(self.manifest))
+            if extra:
+                raise KeyError(
+                    f"checkpoint carries {len(extra)} keys absent from "
+                    f"the manifest, e.g. {extra[:3]} — regenerate the "
+                    f"manifest or fall back to eager load"
+                )
+            self.seconds = time.perf_counter() - self._started_at
+            flight.record(
+                "weights.streamed",
+                model=self.model_id,
+                groups=self.groups_loaded,
+                bytes=self.bytes_loaded,
+                seconds=round(self.seconds, 3),
+            )
+            self.on_complete(unflatten_params(flat))
+        except BaseException as e:  # noqa: BLE001 — surfaced via on_error/first request
+            self.error = e
+            self.seconds = time.perf_counter() - self._started_at
+            flight.record(
+                "weights.stream_error",
+                severity="error",
+                model=self.model_id,
+                error=str(e)[:300],
+            )
+            logger.warning(
+                f"streamed weight load failed for {self.model_id}: {e}"
+            )
+            if self.on_error is not None:
+                self.on_error(e)
+        finally:
+            self.done.set()
+
+    def stats(self) -> dict:
+        return {
+            "npz_path": self.npz_path,
+            "keys": len(self.manifest),
+            "groups_loaded": self.groups_loaded,
+            "bytes_loaded": self.bytes_loaded,
+            "seconds": round(self.seconds, 4),
+            "done": self.done.is_set(),
+            "error": str(self.error) if self.error else None,
+        }

@@ -21,6 +21,8 @@ from bioengine_tpu.serving.scheduler import (
     SchedulingConfig,
 )
 from bioengine_tpu.serving.slo import SLOConfig, SLOEngine
+from bioengine_tpu.serving.compile_tier import CompileCacheTier
+from bioengine_tpu.serving.warm_pool import WarmPool, WarmPoolConfig
 
 __all__ = [
     "AdmissionRejectedError",
@@ -42,4 +44,7 @@ __all__ = [
     "SLOConfig",
     "SLOEngine",
     "ServeController",
+    "CompileCacheTier",
+    "WarmPool",
+    "WarmPoolConfig",
 ]

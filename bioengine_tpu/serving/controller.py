@@ -50,7 +50,10 @@ from bioengine_tpu.serving.replica import (
     ReplicaState,
 )
 from bioengine_tpu.serving.slo import SLOConfig, SLOEngine
+from bioengine_tpu.serving.compile_tier import CompileCacheTier
+from bioengine_tpu.serving.warm_pool import WarmPool, WarmPoolConfig
 from bioengine_tpu.utils import flight, metrics, tracing
+from bioengine_tpu.utils.tasks import spawn_supervised
 from bioengine_tpu.utils.telemetry import (
     SERIES_NAMES,
     RegistrySampler,
@@ -230,6 +233,10 @@ class DeploymentSpec:
     # controller's SLO engine evaluates burn rates against these; None
     # means untracked (no alerting, no budget accounting)
     slo: Optional[SLOConfig] = None
+    # controller-managed standby replicas (manifest warm_pool: block):
+    # pre-started out-of-rotation replicas that absorb scale-up and
+    # preemption by PROMOTION instead of a cold start; None = no pool
+    warm_pool: Optional[WarmPoolConfig] = None
 
     def batch_config(self) -> Optional[dict]:
         if self.max_batch is None and self.max_wait_ms is None:
@@ -629,6 +636,13 @@ class ServeController:
         # swap in a learned scorer without touching the scheduler.
         self._schedulers: dict[tuple[str, str], DeploymentScheduler] = {}
         self.scorer_factory: Callable[[], Any] = HeuristicCostModel
+        # warm pools, one per deployment that opted in via
+        # DeploymentSpec.warm_pool; standbys live here, OUT of the
+        # routing set, until a scale-up/preemption promotes them
+        self._warm_pools: dict[tuple[str, str], WarmPool] = {}
+        # controller-side shared compile-cache tier (served to worker
+        # hosts over the compile_cache_* verbs once attach_rpc runs)
+        self.compile_tier = CompileCacheTier()
         self._replicas_changed = asyncio.Event()
         self._rpc_server = None            # set by attach_rpc (multi-host)
         self._router_admins: list[str] = []
@@ -765,6 +779,24 @@ class ServeController:
             accepted = self.telemetry.ingest(snapshot, host_id=host_id)
             return {"host_id": host_id, "accepted": accepted}
 
+        def compile_cache_list(context=None):
+            check_permissions(context, self._router_admins, "compile_cache_list")
+            return self.compile_tier.list()
+
+        def compile_cache_fetch(name, context=None):
+            # bulk bytes ride the zero-copy OOB transport frame on the
+            # way back; None = tier miss (the host compiles as usual)
+            check_permissions(
+                context, self._router_admins, "compile_cache_fetch"
+            )
+            return self.compile_tier.fetch(name)
+
+        def compile_cache_publish(name, blob, context=None):
+            check_permissions(
+                context, self._router_admins, "compile_cache_publish"
+            )
+            return {"name": name, "stored": self.compile_tier.publish(name, blob)}
+
         server.register_local_service(
             {
                 "id": "serve-router",
@@ -778,6 +810,9 @@ class ServeController:
                 "register_host": register_host,
                 "deregister_host": deregister_host,
                 "push_telemetry": push_telemetry,
+                "compile_cache_list": compile_cache_list,
+                "compile_cache_fetch": compile_cache_fetch,
+                "compile_cache_publish": compile_cache_publish,
             }
         )
 
@@ -872,8 +907,17 @@ class ServeController:
                         )
                 if spec.slo is not None:
                     self.slo.register(app_id, spec.name, spec.slo)
+                if spec.warm_pool is not None and spec.warm_pool.size > 0:
+                    self._warm_pools[(app_id, spec.name)] = WarmPool(
+                        app_id, spec.name, spec.warm_pool
+                    )
                 for _ in range(spec.num_replicas):
                     await self._add_replica(app, spec)
+            # pools fill AFTER every serving replica is placed — a tight
+            # cluster spends its chips on the routing set first
+            for spec in specs:
+                if (app_id, spec.name) in self._warm_pools:
+                    await self._top_up_warm_pool(app, spec)
             app.status = "RUNNING"
             self.logger.info(f"app '{app_id}' deployed")
         except Exception:
@@ -885,6 +929,13 @@ class ServeController:
                 sched = self._schedulers.pop((app_id, spec.name), None)
                 if sched is not None:
                     await sched.close()
+                pool = self._warm_pools.pop((app_id, spec.name), None)
+                if pool is not None:
+                    for r in pool.drain_all():
+                        try:
+                            await r.stop()
+                        finally:
+                            self.cluster_state.mark_replica_dead(r.replica_id)
             for replicas in app.replicas.values():
                 for r in replicas:
                     try:
@@ -907,6 +958,70 @@ class ServeController:
             return await self._add_replica_inner(app, spec)
 
     async def _add_replica_inner(self, app: AppDeployment, spec: DeploymentSpec):
+        # warm-pool fast path: a scale-up or preemption restart PROMOTES
+        # a pre-started standby (instance built, weights resident,
+        # programs warm) instead of paying the cold start — the pool
+        # refills itself in the background
+        pool = self._warm_pools.get((app.app_id, spec.name))
+        if pool is not None:
+            dead_hosts = {
+                h.host_id
+                for h in self.cluster_state.hosts.values()
+                if not h.alive
+            }
+            promoted = pool.pop_routable(skip_hosts=dead_hosts)
+            if promoted is not None:
+                app.replicas[spec.name].append(promoted)
+                self.cluster_state.remove_pending(f"{app.app_id}/{spec.name}")
+                self._replicas_changed.set()
+                self.logger.info(
+                    f"promoted warm standby {promoted.replica_id} for "
+                    f"{app.app_id}/{spec.name} "
+                    f"(pool occupancy {len(pool.standbys)})"
+                )
+                flight.record(
+                    "replica.place",
+                    replica=promoted.replica_id,
+                    app=app.app_id,
+                    deployment=spec.name,
+                    host=getattr(promoted, "host_id", None),
+                    device_ids=list(promoted.device_ids),
+                    warm_pool=True,
+                )
+                if pool.config.refill:
+                    spawn_supervised(
+                        self._top_up_warm_pool(app, spec),
+                        name=f"warmpool-refill-{app.app_id}-{spec.name}",
+                        logger=self.logger,
+                    )
+                return promoted
+        replica = await self._place_new_replica(app, spec)
+        app.replicas[spec.name].append(replica)
+        self.cluster_state.remove_pending(f"{app.app_id}/{spec.name}")
+        self._replicas_changed.set()  # wake requests parked in _pick_replica_wait
+        flight.record(
+            "replica.place",
+            replica=replica.replica_id,
+            app=app.app_id,
+            deployment=spec.name,
+            host=getattr(replica, "host_id", None),
+            device_ids=list(replica.device_ids),
+        )
+        return replica
+
+    async def _place_new_replica(
+        self,
+        app: AppDeployment,
+        spec: DeploymentSpec,
+        pending_on_fail: bool = True,
+        record_failed: bool = True,
+    ):
+        """Place and START one replica (local chips → joined host →
+        pending workload) WITHOUT adding it to the routing set — shared
+        by the serving path (_add_replica) and the warm-pool fill.
+        ``record_failed`` keeps the legacy behavior of surfacing a
+        start-failed replica in app.replicas (the health loop retires
+        it); pool fills opt out — a failed standby just isn't a standby."""
         replica = None
         host_id = None
         if spec.chips_per_replica > 0 and (
@@ -917,10 +1032,11 @@ class ServeController:
                 # No capacity anywhere: surface as pending workload so
                 # the provisioner can scale out (ref manager.py:239-353's
                 # SLURM headroom allowance).
-                self.cluster_state.add_pending(
-                    f"{app.app_id}/{spec.name}",
-                    {"chips": spec.chips_per_replica},
-                )
+                if pending_on_fail:
+                    self.cluster_state.add_pending(
+                        f"{app.app_id}/{spec.name}",
+                        {"chips": spec.chips_per_replica},
+                    )
                 raise RuntimeError(
                     f"need {spec.chips_per_replica} chips for "
                     f"{app.app_id}/{spec.name}: none free locally or on "
@@ -951,20 +1067,105 @@ class ServeController:
             await replica.start()
         except Exception:
             self.cluster_state.mark_replica_dead(replica.replica_id)
-            app.replicas[spec.name].append(replica)
+            if record_failed:
+                app.replicas[spec.name].append(replica)
             raise
-        app.replicas[spec.name].append(replica)
-        self.cluster_state.remove_pending(f"{app.app_id}/{spec.name}")
-        self._replicas_changed.set()  # wake requests parked in _pick_replica_wait
-        flight.record(
-            "replica.place",
-            replica=replica.replica_id,
-            app=app.app_id,
-            deployment=spec.name,
-            host=host_id,
-            device_ids=list(replica.device_ids),
-        )
         return replica
+
+    # ---- warm pool ----------------------------------------------------------
+
+    async def _top_up_warm_pool(
+        self, app: AppDeployment, spec: DeploymentSpec
+    ) -> None:
+        """Fill the deployment's pool to its target size (config, or
+        telemetry-grown toward max_size). Capacity shortfalls log and
+        stop — a pool never queues pending workloads against the
+        provisioner; serving replicas take that priority."""
+        pool = self._warm_pools.get((app.app_id, spec.name))
+        if pool is None:
+            return
+        target = pool.target_size(self.telemetry)
+        # filling counts in-flight placements: a promotion-triggered
+        # refill and a concurrent health tick must not both fill the
+        # same slot (a cold start takes seconds — plenty of overlap)
+        while len(pool.standbys) + pool.filling < target:
+            if app.app_id not in self.apps or app.status == "STOPPED":
+                return
+            pool.filling += 1
+            try:
+                replica = await self._place_new_replica(
+                    app, spec, pending_on_fail=False, record_failed=False
+                )
+            except Exception as e:  # noqa: BLE001 — capacity may come later
+                pool.fill_failures += 1
+                self.logger.warning(
+                    f"warm-pool fill blocked for "
+                    f"{app.app_id}/{spec.name}: {e}"
+                )
+                return
+            finally:
+                pool.filling -= 1
+            if self._warm_pools.get((app.app_id, spec.name)) is not pool:
+                # undeployed while the standby was starting
+                await self._retire_replica(replica)
+                return
+            pool.add(replica)
+
+    async def _warm_pool_tick(
+        self, app: AppDeployment, spec: DeploymentSpec
+    ) -> None:
+        """Health-loop pool maintenance: standbys are health-checked
+        (a preempted host's standby must not be promoted into a black
+        hole), dead ones released, and the pool refilled to target."""
+        pool = self._warm_pools.get((app.app_id, spec.name))
+        if pool is None:
+            return
+        # bounded-concurrent checks, exactly like the serving replicas'
+        # path — a dead host's standbys each cost a 30 s health timeout
+        # and must not serialize the whole health loop behind it
+        sem = asyncio.Semaphore(self.health_check_concurrency)
+
+        async def checked(r) -> None:
+            async with sem:
+                try:
+                    await r.check_health()
+                except Exception:  # noqa: BLE001 — a throwing check is unhealthy
+                    r.state = ReplicaState.UNHEALTHY
+
+        await asyncio.gather(*(checked(r) for r in list(pool.standbys)))
+        for dead in pool.remove_dead():
+            self.logger.warning(
+                f"warm standby {dead.replica_id} unhealthy; releasing"
+            )
+            try:
+                await dead.stop()
+            finally:
+                self.cluster_state.mark_replica_dead(dead.replica_id)
+        target = pool.target_size(self.telemetry)
+        # shrink an over-target pool (telemetry sizing receded, or a
+        # refill raced a promotion before the filling counter existed):
+        # retire the youngest standby — idle chips go back to the fleet
+        while len(pool.standbys) > target:
+            victim = pool.standbys.pop()
+            self.logger.info(
+                f"warm pool over target for {app.app_id}/{spec.name}; "
+                f"retiring standby {victim.replica_id}"
+            )
+            try:
+                await victim.stop()
+            finally:
+                self.cluster_state.mark_replica_dead(victim.replica_id)
+        if pool.config.refill and (
+            len(pool.standbys) + pool.filling < target
+        ):
+            # a refill is a full cold start — run it off the health
+            # loop (the filling counter keeps concurrent runs from
+            # overfilling the same slot)
+            spawn_supervised(
+                self._top_up_warm_pool(app, spec),
+                name=f"warmpool-tick-refill-{app.app_id}-{spec.name}",
+                logger=self.logger,
+            )
 
     def _readopt_replica(
         self, host_id: str, service_id: str, info: dict
@@ -1057,6 +1258,13 @@ class ServeController:
             sched = self._schedulers.pop((app_id, name), None)
             if sched is not None:
                 await sched.close()
+        # warm standbys carry no traffic — retired alongside the
+        # serving replicas so their chip leases release with the app
+        standbys: list = []
+        for name in app.specs:
+            pool = self._warm_pools.pop((app_id, name), None)
+            if pool is not None:
+                standbys.extend(pool.drain_all())
         # drain-then-stop every replica concurrently: new calls are
         # rejected the moment states flip to DRAINING, in-flight
         # requests get up to drain_timeout_s to finish
@@ -1065,7 +1273,8 @@ class ServeController:
                 self._retire_replica(r, drain_timeout_s)
                 for replicas in app.replicas.values()
                 for r in replicas
-            )
+            ),
+            *(self._retire_replica(r, drain_timeout_s) for r in standbys),
         )
         # router-state leak fix: get_handle/_pick_replica seeded
         # per-deployment entries that previously outlived the app —
@@ -1315,6 +1524,7 @@ class ServeController:
                     )
                     break
             await self._autoscale(app, spec)
+            await self._warm_pool_tick(app, spec)
             alive = [
                 r
                 for r in app.replicas.get(spec_name, [])
@@ -1494,9 +1704,41 @@ class ServeController:
         # 0 and faking an idle queue to least-loaded routing decisions
         queued = [d.get("queued_requests") for d in described]
         scheduler = self._schedulers.get((app_id, name))
+        pool = self._warm_pools.get((app_id, name))
+        # per-deployment compile rollup from the replicas' engine
+        # describes (joined on the same RuntimeDeployment._status_key
+        # the pipeline/mesh views use): how many "compiles" were
+        # persistent/tier cache hits vs real XLA work
+        tier_hits = real_compiles = 0
+        for d in described:
+            for eng in ((d.get("mesh") or {}).get("engines") or {}).values():
+                progs = eng.get("programs") or {}
+                tier_hits += int(progs.get("persistent_hits") or 0)
+                real_compiles += int(progs.get("real_compiles") or 0)
+        # the newest replica's TTFR breakdown — the number the warm
+        # path is accountable for, fresh from the latest scale-up
+        last_ttfr = None
+        for d in reversed(described):
+            cold = d.get("cold_start") or {}
+            if cold.get("ttfr_seconds") is not None:
+                last_ttfr = cold
+                break
         return {
             "num_replicas": len(replicas),
             "scheduler": scheduler.describe() if scheduler else None,
+            "cold_start": {
+                "warm_pool": pool.stats() if pool else None,
+                "last_replica_ttfr": last_ttfr,
+                "compile": {
+                    "persistent_cache_hits": tier_hits,
+                    "real_compiles": real_compiles,
+                    "hit_rate": round(
+                        tier_hits / (tier_hits + real_compiles), 4
+                    )
+                    if (tier_hits + real_compiles)
+                    else None,
+                },
+            },
             "replicas": described,
             "queue_depth": self._queue_depth.get((app_id, name), 0),
             "outstanding_calls": sum(
@@ -1730,6 +1972,7 @@ class ServeController:
             ),
             "metrics": metrics.collect(),
             "slo": self.slo.status(),
+            "compile_tier": self.compile_tier.stats(),
             "telemetry": self.telemetry.describe(),
             "cluster": self.cluster_state.snapshot(),
             "apps": {

@@ -71,6 +71,13 @@ class RemoteReplica(ReplicaStateMixin):
         self._idle_event = asyncio.Event()
         self._idle_event.set()
         self._log_sink = log_sink
+        # controller-side TTFR view of a remote replica: coarse by
+        # design (the host-side Replica owns the fine breakdown via its
+        # own describe) — what promotion re-anchors is the span the
+        # warm pool is accountable for
+        self.ttfr: dict[str, Any] = {}
+        self.promoted_from_warm_pool = False
+        self._first_request_done = False
 
     def _log(self, line: str) -> None:
         if self._log_sink:
@@ -90,6 +97,9 @@ class RemoteReplica(ReplicaStateMixin):
                 payload=self._payload,
             )
             self.state = ReplicaState(result["state"])
+            self.ttfr["init_seconds"] = round(
+                time.monotonic() - self._started_mono, 4
+            )
             self._log(f"remote replica started (state={self.state})")
         except Exception as e:
             self.last_error = str(e)[-2000:]
@@ -222,7 +232,7 @@ class RemoteReplica(ReplicaStateMixin):
                 host=self.host_id,
                 method=method,
             ):
-                return await self._call_host(
+                result = await self._call_host(
                     self.host_service_id,
                     "replica_call",
                     self.replica_id,
@@ -231,6 +241,22 @@ class RemoteReplica(ReplicaStateMixin):
                     kwargs or {},
                     **extra,
                 )
+            if not self._first_request_done:
+                self._first_request_done = True
+                self.ttfr["ttfr_seconds"] = round(
+                    time.monotonic() - self._started_mono, 4
+                )
+                flight.record(
+                    "replica.first_request",
+                    replica=self.replica_id,
+                    app=self.app_id,
+                    deployment=self.deployment_name,
+                    host=self.host_id,
+                    method=method,
+                    ttfr_seconds=self.ttfr["ttfr_seconds"],
+                    warm_pool=self.promoted_from_warm_pool,
+                )
+            return result
         except KeyError as e:
             # a raw KeyError here is the ROUTER's (host service gone
             # from the registry, i.e. the websocket dropped) — app
@@ -292,11 +318,22 @@ class RemoteReplica(ReplicaStateMixin):
             if self._ongoing == 0:
                 self._idle_event.set()
 
+    def mark_promoted(self) -> None:
+        """Warm-pool standby → serving replica (see Replica.mark_promoted)."""
+        self.promoted_from_warm_pool = True
+        self.ttfr["standby_seconds"] = round(
+            time.monotonic() - self._started_mono, 4
+        )
+        self._started_mono = time.monotonic()
+        self._first_request_done = False
+
     @property
     def load(self) -> float:
         return self._ongoing / max(1, self.max_ongoing_requests)
 
     def describe(self) -> dict:
+        cold = dict(self.ttfr)
+        cold["promoted_from_warm_pool"] = self.promoted_from_warm_pool
         return {
             "replica_id": self.replica_id,
             "deployment": self.deployment_name,
@@ -310,6 +347,7 @@ class RemoteReplica(ReplicaStateMixin):
             # the controller rollup treats a missing key as unknown
             "total_requests": self._total_requests,
             "load": self.load,
+            "cold_start": cold,
             "uptime_seconds": time.monotonic() - self._started_mono,
             "last_error": self.last_error,
         }

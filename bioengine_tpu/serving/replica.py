@@ -149,6 +149,16 @@ class Replica(ReplicaStateMixin):
         self._init_done = False
         self.started_at = time.time()
         self._started_mono = time.monotonic()
+        # time-to-first-request breakdown — the number the whole
+        # cold-start machinery (compile tier, streamed weights, warm
+        # pool) exists to shrink. ttfr_seconds is construction -> first
+        # COMPLETED request; init_seconds is the instance build +
+        # async_init slice of it. Promoted warm-pool standbys re-anchor
+        # at promotion (promote -> first request is the span that
+        # matters to the autoscaler).
+        self.ttfr: dict[str, Any] = {}
+        self.promoted_from_warm_pool = False
+        self._first_request_done = False
         self.last_error: Optional[str] = None
         self._log_sink = log_sink
         self.logger = create_logger(f"replica.{self.replica_id}", log_file="off")
@@ -208,6 +218,9 @@ class Replica(ReplicaStateMixin):
             if hasattr(self.instance, "async_init"):
                 await _maybe_await(self.instance.async_init())
             self._init_done = True
+            self.ttfr["init_seconds"] = round(
+                time.monotonic() - self._started_mono, 4
+            )
             if hasattr(self.instance, "test_deployment"):
                 self.state = ReplicaState.TESTING
                 self._test_task = asyncio.create_task(self._run_test())
@@ -378,6 +391,7 @@ class Replica(ReplicaStateMixin):
             self._idle_event.clear()
             if self._requests_total is not None:
                 self._requests_total.inc()
+            first = not self._first_request_done
             t_exec = time.monotonic()
             # chip-seconds accumulate here, where app/deployment/method
             # labels exist: engines called (directly or through the
@@ -393,7 +407,29 @@ class Replica(ReplicaStateMixin):
                     replica=self.replica_id,
                     method=method,
                 ):
-                    return await _maybe_await(fn(*args, **kwargs))
+                    result = await _maybe_await(fn(*args, **kwargs))
+                if first and not self._first_request_done:
+                    self._first_request_done = True
+                    now = time.monotonic()
+                    self.ttfr["first_request_seconds"] = round(
+                        now - t_exec, 4
+                    )
+                    self.ttfr["ttfr_seconds"] = round(
+                        now - self._started_mono, 4
+                    )
+                    # the closing event of the scale-up→first-request
+                    # flight timeline (replica.place / warmpool.promote
+                    # opened it, program.compile sits in between)
+                    flight.record(
+                        "replica.first_request",
+                        replica=self.replica_id,
+                        app=self.app_id,
+                        deployment=self.deployment_name,
+                        method=method,
+                        ttfr_seconds=self.ttfr["ttfr_seconds"],
+                        warm_pool=self.promoted_from_warm_pool,
+                    )
+                return result
             finally:
                 tracing.stop_chip_accounting(cs_token)
                 if acc.seconds > 0.0:
@@ -467,6 +503,17 @@ class Replica(ReplicaStateMixin):
             return await gathered
         return await asyncio.wait_for(gathered, timeout_s)
 
+    def mark_promoted(self) -> None:
+        """Warm-pool standby → serving replica: re-anchor the TTFR
+        clock at promotion (the pool already paid init/compile/load;
+        the span an operator cares about is promote → first request)."""
+        self.promoted_from_warm_pool = True
+        self.ttfr["standby_seconds"] = round(
+            time.monotonic() - self._started_mono, 4
+        )
+        self._started_mono = time.monotonic()
+        self._first_request_done = False
+
     @property
     def load(self) -> float:
         return self._ongoing / max(1, self.max_ongoing_requests)
@@ -496,6 +543,18 @@ class Replica(ReplicaStateMixin):
             "uptime_seconds": time.monotonic() - self._started_mono,
             "last_error": self.last_error,
         }
+        # cold-start surface: the replica-level TTFR breakdown plus the
+        # per-pipeline weights/compile detail from deployments that
+        # expose ``cold_start_info()`` (model-runner's RuntimeDeployment)
+        cold: dict = dict(self.ttfr)
+        cold["promoted_from_warm_pool"] = self.promoted_from_warm_pool
+        cs_fn = getattr(self.instance, "cold_start_info", None)
+        if callable(cs_fn):
+            try:
+                cold["pipelines"] = cs_fn()
+            except Exception as e:  # noqa: BLE001 — stats never break health
+                cold["pipelines"] = {"error": str(e)}
+        d["cold_start"] = cold
         # deployments that run the overlapped inference pipeline expose
         # a sync ``pipeline_stats()`` (e.g. model-runner's
         # RuntimeDeployment); surface it so the controller's

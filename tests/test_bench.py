@@ -118,6 +118,57 @@ def test_sharded_serving_stage_schema():
     assert st["parity_ok"], st["parity_max_abs_err"]
 
 
+def test_cold_start_stage_schema():
+    """Pin the cold_start artifact schema: replica TTFR on the
+    model-runner path across three legs — cold (fresh process, empty
+    compile cache), warm-cache (fresh process against the cache the
+    cold leg populated — the shared-tier experience), warm-pool
+    (standby promotion) — each with its compile/load/first-request
+    breakdown. The acceptance gate is the warm-pool path: promotion
+    must beat the cold path by ≥10x even on a loaded CI core (it's a
+    list move vs an XLA compile)."""
+    proc, lines = _run(
+        {
+            "BENCH_CONFIGS": "cold_start",
+            "BENCH_DEADLINE": "170",
+        },
+        timeout=200.0,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    st = json.loads(lines[-1])["extra"]["cold_start"]
+    assert st["ok"], st
+    for key in (
+        "cold",
+        "warm_cache",
+        "warm_pool",
+        "speedup_warm_cache",
+        "speedup_warm_pool",
+        "warm_cache_hit_observed",
+    ):
+        assert key in st, key
+    for leg in ("cold", "warm_cache"):
+        for key in (
+            "ttfr_s",
+            "build_s",
+            "first_request_s",
+            "weights_s",
+            "compile_s",
+            "streamed",
+            "persistent_cache_hits",
+            "real_compiles",
+        ):
+            assert key in st[leg], (leg, key)
+    assert st["cold"]["streamed"] is True        # manifest package streams
+    assert st["cold"]["real_compiles"] >= 1       # the cold leg compiled
+    assert st["warm_cache_hit_observed"] is True  # the warm leg did not
+    wp = st["warm_pool"]
+    assert wp["promoted_from_warm_pool"] is True
+    assert wp["promotions"] == 1
+    assert wp["ttfr_s"] > 0
+    # the acceptance ratio: warm-pool TTFR ≥10x faster than cold
+    assert st["speedup_warm_pool"] >= 10.0, st["speedup_warm_pool"]
+
+
 def test_rpc_transport_stage_schema():
     """Pin the rpc_transport artifact schema: three paths (legacy /
     zero-copy oob / shm), per-size e2e + codec round-trip numbers, the
