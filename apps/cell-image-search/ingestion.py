@@ -313,7 +313,15 @@ async def run_ingestion(
     )
 
     async def _as_async(sync_iter):
-        for item in sync_iter:
+        # pull each item off-loop: local-source iteration np.loads /
+        # PNG-decodes full images, which would stall query traffic
+        # sharing this event loop
+        it = iter(sync_iter)
+        sentinel = object()
+        while True:
+            item = await asyncio.to_thread(next, it, sentinel)
+            if item is sentinel:
+                return
             yield item
 
     source = dataset.get("source", "synthetic")

@@ -587,8 +587,14 @@ class EntryDeployment:
                 sample_id=sample_id,
             )
         if return_download_url:
+            # np.save of full-size masks/flows is bulk disk I/O —
+            # serialize each array off the event loop
             result = {
-                k: (self._save_temp_array(v) if isinstance(v, np.ndarray) else v)
+                k: (
+                    await asyncio.to_thread(self._save_temp_array, v)
+                    if isinstance(v, np.ndarray)
+                    else v
+                )
                 for k, v in result.items()
             }
         return result
@@ -643,7 +649,8 @@ class EntryDeployment:
                     f"uploaded file '{source}' not found or expired"
                 )
             raw, name = await asyncio.to_thread(path.read_bytes), str(path)
-        return self._decode_array(raw, name)
+        # decode (np.load / PNG decompress) is CPU+alloc heavy — off-loop
+        return await asyncio.to_thread(self._decode_array, raw, name)
 
     @staticmethod
     def _decode_array(raw: bytes, name: str) -> np.ndarray:

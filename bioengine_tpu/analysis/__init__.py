@@ -1,22 +1,39 @@
-"""Static analysis for BioEngine-TPU: async-safety + JAX tracer-safety.
+"""Static analysis for BioEngine-TPU: a two-phase whole-program linter.
 
 The orchestration layer (RPC server, proxies, worker monitor loop) is
-asyncio end to end, and the compute layer is jitted JAX — the two bug
-classes that slip past unit tests are *blocking calls / unguarded
-shared state inside the event loop* and *silent tracer-safety
-violations inside jitted code*.  This package catches both statically:
+asyncio end to end, the compute layer is jitted JAX, and the
+distributed plane is held together by stringly-typed contracts (RPC
+verbs, capability tokens, flight events, metric families, env knobs).
+This package catches all three failure classes statically:
+
+**Phase 1 (per module, parallel, cached)** parses each module once,
+runs the module-local passes, and extracts a fact index — defs, an
+approximate call graph, and every cross-module contract string.
+
+**Phase 2 (whole program)** evaluates interprocedural and
+cross-module rules over the union of all module indexes plus the
+documentation catalogs.
 
 - :mod:`bioengine_tpu.analysis.core` — AST-walker framework, rule
   registry, ``# bioengine: ignore[RULE]`` suppressions.
-- :mod:`bioengine_tpu.analysis.async_rules` — BE-ASYNC-* rules.
+- :mod:`bioengine_tpu.analysis.project` — phase-1 index, cache,
+  incremental/parallel build, doc-catalog extraction.
+- :mod:`bioengine_tpu.analysis.async_rules` — BE-ASYNC-001..005
+  (module-local event-loop hazards).
+- :mod:`bioengine_tpu.analysis.interproc` — BE-ASYNC-006..008
+  (call-graph async-safety).
 - :mod:`bioengine_tpu.analysis.jax_rules` — BE-JAX-* rules.
 - :mod:`bioengine_tpu.analysis.obs_rules` — BE-OBS-* rules.
+- :mod:`bioengine_tpu.analysis.dist_rules` — BE-DIST-2xx
+  distributed-contract drift rules.
+- :mod:`bioengine_tpu.analysis.sarif` — SARIF 2.1.0 export for CI
+  code-scanning annotations.
 - :mod:`bioengine_tpu.analysis.baseline` — checked-in baseline so
   pre-existing, justified findings don't block CI.
 
 Run it as ``python -m bioengine_tpu.analysis <paths>`` or
 ``bioengine analyze``.  See docs/static-analysis.md for the rule
-catalog.
+catalog and the two-phase architecture.
 """
 
 from bioengine_tpu.analysis.core import (
@@ -37,6 +54,14 @@ from bioengine_tpu.analysis.baseline import (
 from bioengine_tpu.analysis import async_rules as _async_rules  # noqa: F401
 from bioengine_tpu.analysis import jax_rules as _jax_rules  # noqa: F401
 from bioengine_tpu.analysis import obs_rules as _obs_rules  # noqa: F401
+from bioengine_tpu.analysis import dist_rules as _dist_rules  # noqa: F401
+from bioengine_tpu.analysis import interproc as _interproc  # noqa: F401
+
+from bioengine_tpu.analysis.project import (
+    analyze_project,
+    build_project_index,
+    parse_docs,
+)
 
 __all__ = [
     "Finding",
@@ -45,7 +70,10 @@ __all__ = [
     "all_rules",
     "analyze_file",
     "analyze_paths",
+    "analyze_project",
     "analyze_source",
+    "build_project_index",
     "fingerprint",
     "get_rule",
+    "parse_docs",
 ]

@@ -2,6 +2,13 @@
 
 Exit codes: 0 clean (or all findings baselined/suppressed), 1 findings,
 2 usage/internal error.  ``bioengine analyze`` wraps this entry point.
+
+The run is two-phase: phase 1 indexes every module in scope (process
+pool via ``--jobs``, content-hash cache at ``--cache``), phase 2 runs
+the cross-module rule families over the full fact base.  ``--changed``
+narrows *module-local* reporting to edited files but still re-runs the
+cross-module rules against the whole project — an unchanged module can
+break a contract a changed one relied on.
 """
 
 from __future__ import annotations
@@ -10,17 +17,19 @@ import argparse
 import json
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 from bioengine_tpu.analysis import (
     Baseline,
     all_rules,
-    analyze_paths,
+    analyze_project,
 )
 from bioengine_tpu.analysis.baseline import (
     DEFAULT_BASELINE,
     TODO_JUSTIFICATION,
 )
+from bioengine_tpu.analysis.project import DEFAULT_CACHE
 
 
 def _git_changed_files(ref: str) -> list[Path] | None:
@@ -63,7 +72,11 @@ def _git_changed_files(ref: str) -> list[Path] | None:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m bioengine_tpu.analysis",
-        description="BioEngine async-safety + JAX tracer-safety linter",
+        description=(
+            "BioEngine whole-program linter: async-safety, JAX "
+            "tracer-safety, observability discipline, and "
+            "distributed-contract drift"
+        ),
     )
     p.add_argument(
         "paths",
@@ -93,8 +106,10 @@ def build_parser() -> argparse.ArgumentParser:
         const="HEAD",
         default=None,
         metavar="REF",
-        help="scan only files changed vs REF (default HEAD) + untracked, "
-        "intersected with PATHS — keeps the CI gate fast",
+        help="report module-local findings only for files changed vs REF "
+        "(default HEAD) + untracked, intersected with PATHS; "
+        "cross-module rules still run over the full project "
+        "(edited modules re-index, the rest come from the cache)",
     )
     p.add_argument(
         "--rule",
@@ -105,8 +120,34 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--format",
-        choices=("text", "json"),
+        choices=("text", "json", "sarif"),
         default="text",
+    )
+    p.add_argument(
+        "--jobs",
+        type=int,
+        default=None,
+        metavar="N",
+        help="index worker processes (default: os.cpu_count())",
+    )
+    p.add_argument(
+        "--cache",
+        type=Path,
+        default=DEFAULT_CACHE,
+        metavar="FILE",
+        help=f"module-index cache (default: {DEFAULT_CACHE}; "
+        "content-hash keyed, safe to delete)",
+    )
+    p.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="ignore and don't write the index cache",
+    )
+    p.add_argument(
+        "--stats",
+        action="store_true",
+        help="print indexing/evaluation wall time and cache hit counts "
+        "to stderr",
     )
     p.add_argument(
         "--list-rules",
@@ -121,8 +162,22 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.list_rules:
         for r in all_rules():
-            print(f"{r.id}  {r.slug:32s} [{r.pass_name}] {r.summary}")
+            scope = "project" if r.project else "module"
+            print(
+                f"{r.id}  {r.slug:34s} [{r.pass_name}/{scope}] {r.summary}"
+            )
         return 0
+
+    if args.write_baseline and args.changed is not None:
+        # --changed narrows the finding set; rebuilding the baseline
+        # from it would silently drop (and lose the justifications of)
+        # every entry for unchanged files
+        print(
+            "error: --write-baseline requires a full scan — "
+            "drop --changed",
+            file=sys.stderr,
+        )
+        return 2
 
     scan_paths = [Path(p) for p in args.paths]
     missing = [p for p in scan_paths if not p.exists()]
@@ -133,6 +188,7 @@ def main(argv: list[str] | None = None) -> int:
         )
         return 2
 
+    report_paths: list[Path] | None = None
     if args.changed is not None:
         changed = _git_changed_files(args.changed)
         if changed is None:
@@ -142,7 +198,7 @@ def main(argv: list[str] | None = None) -> int:
             )
         else:
             roots = [p.resolve() for p in scan_paths]
-            scan_paths = [
+            report_paths = [
                 f
                 for f in changed
                 if f.exists()
@@ -151,12 +207,28 @@ def main(argv: list[str] | None = None) -> int:
                     for r in roots
                 )
             ]
-            if not scan_paths:
-                print("analyze: no changed python files in scope")
-                return 0
 
     rules = set(args.rule) if args.rule else None
-    findings = analyze_paths(scan_paths, rules=rules)
+    cache_path = None if args.no_cache else args.cache
+    t0 = time.monotonic()
+    findings, stats = analyze_project(
+        scan_paths,
+        root=Path.cwd(),
+        report_paths=report_paths,
+        rules=rules,
+        jobs=args.jobs,
+        cache_path=cache_path,
+    )
+    wall_s = time.monotonic() - t0
+
+    if args.stats:
+        print(
+            f"analyze: {stats.files_total} modules "
+            f"({stats.files_indexed} indexed, {stats.files_cached} from "
+            f"cache, jobs={stats.jobs}) — index {stats.wall_s:.2f}s, "
+            f"total {wall_s:.2f}s",
+            file=sys.stderr,
+        )
 
     baseline_path = args.baseline or DEFAULT_BASELINE
     baseline = Baseline()
@@ -208,6 +280,10 @@ def main(argv: list[str] | None = None) -> int:
                 indent=2,
             )
         )
+    elif args.format == "sarif":
+        from bioengine_tpu.analysis.sarif import render_sarif
+
+        print(json.dumps(render_sarif(new), indent=2))
     else:
         for f in new:
             print(f.render())

@@ -44,7 +44,10 @@ class Rule:
     id: str
     slug: str
     summary: str
-    pass_name: str  # "async" | "jax"
+    pass_name: str  # "async" | "jax" | "obs" | "dist"
+    # project rules run in phase 2 over the whole-program index
+    # (project.ProjectContext), not per-module AST walks
+    project: bool = False
 
 
 @dataclass
@@ -62,9 +65,13 @@ class ModuleContext:
 
 
 PassFn = Callable[[ModuleContext], Iterable[Finding]]
+# a project pass receives a project.ProjectContext (typed loosely here
+# to avoid a circular import with the index module)
+ProjectPassFn = Callable[[object], Iterable[Finding]]
 
 _RULES: dict[str, Rule] = {}
 _PASSES: dict[str, PassFn] = {}
+_PROJECT_PASSES: dict[str, ProjectPassFn] = {}
 
 
 def register_rule(rule: Rule) -> Rule:
@@ -76,6 +83,14 @@ def register_rule(rule: Rule) -> Rule:
 
 def register_pass(name: str, fn: PassFn) -> None:
     _PASSES[name] = fn
+
+
+def register_project_pass(name: str, fn: ProjectPassFn) -> None:
+    _PROJECT_PASSES[name] = fn
+
+
+def project_passes() -> dict[str, ProjectPassFn]:
+    return dict(_PROJECT_PASSES)
 
 
 def all_rules() -> list[Rule]:
@@ -172,7 +187,18 @@ def analyze_source(
         ]
     lines = source.splitlines()
     ctx = ModuleContext(path=path, source=source, tree=tree, lines=lines)
-    per_line, file_wide = _parse_suppressions(lines)
+    out = run_module_passes(ctx, rules=rules)
+    out.sort(key=lambda f: (f.line, f.col, f.rule))
+    return out
+
+
+def run_module_passes(
+    ctx: ModuleContext, rules: Optional[set[str]] = None
+) -> list[Finding]:
+    """Every per-module pass over an already-parsed module, with
+    suppression comments applied.  The project indexer reuses this so
+    one parse serves both phase-1 indexing and the module rules."""
+    per_line, file_wide = _parse_suppressions(ctx.lines)
     out: list[Finding] = []
     for fn in _PASSES.values():
         for f in fn(ctx):
@@ -181,7 +207,6 @@ def analyze_source(
             if _suppressed(f, per_line, file_wide):
                 continue
             out.append(f)
-    out.sort(key=lambda f: (f.line, f.col, f.rule))
     return out
 
 

@@ -274,7 +274,7 @@ class AppsManager:
                 env_vars=dict(env_vars or {}),
                 frontend_url=frontend_url,
             )
-            self._save_records()
+            await asyncio.to_thread(self._save_records)
             self.logger.info(
                 f"deployed '{app_id}' ({built.manifest.name}) "
                 f"by {deployer}"
@@ -311,7 +311,7 @@ class AppsManager:
             unregister(app_id)
         record.proxy.deregister()
         await self.controller.undeploy(app_id)
-        self._save_records()
+        await asyncio.to_thread(self._save_records)
 
     async def stop_app(self, app_id: str, context: Optional[dict] = None) -> dict:
         check_permissions(context, self.admin_users, "stop_app")

@@ -20,13 +20,16 @@ import click
 )
 @click.argument("analyzer_args", nargs=-1, type=click.UNPROCESSED)
 def analyze_command(analyzer_args: tuple[str, ...]) -> None:
-    """Run the async-safety + JAX tracer-safety linter.
+    """Run the whole-program linter (async-safety, JAX tracer-safety,
+    distributed-contract drift).
 
     Examples:
 
       bioengine analyze bioengine_tpu/ apps/
 
       bioengine analyze --changed origin/main
+
+      bioengine analyze --format sarif --stats --jobs 8
 
       bioengine analyze --list-rules
     """

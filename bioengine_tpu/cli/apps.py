@@ -9,6 +9,7 @@ client's filesystem.
 
 from __future__ import annotations
 
+import asyncio
 import json
 
 import click
@@ -30,8 +31,10 @@ def apps_group() -> None:
 
 
 async def _upload_dir(worker, src_dir, artifact_id=None, version=None) -> dict:
+    # bulk file reads off the loop — the RPC connection heartbeats on it
+    files = await asyncio.to_thread(read_dir_files, src_dir)
     return await worker.upload_app(
-        files=read_dir_files(src_dir), artifact_id=artifact_id, version=version
+        files=files, artifact_id=artifact_id, version=version
     )
 
 

@@ -7,6 +7,7 @@ payload, auto-typed ``--arg k=v`` pairs, image file inputs/outputs
 
 from __future__ import annotations
 
+import asyncio
 import json
 from typing import Optional
 
@@ -88,7 +89,7 @@ def call_command(
                         f"--image-arg expects k=path, got '{pair}'"
                     )
                 key, _, path = pair.partition("=")
-                kwargs[key] = read_image(path)
+                kwargs[key] = await asyncio.to_thread(read_image, path)
             svc = await conn.get_service(service_id)
             return await getattr(svc, method)(**kwargs)
         finally:

@@ -56,10 +56,11 @@ def resolve_token(token: Optional[str]) -> Optional[str]:
 async def connect(
     server_url: Optional[str], token: Optional[str] = None
 ) -> ServerConnection:
+    resolved_token = await asyncio.to_thread(resolve_token, token)
     return await connect_to_server(
         {
             "server_url": resolve_server_url(server_url),
-            "token": resolve_token(token),
+            "token": resolved_token,
         }
     )
 

@@ -189,15 +189,29 @@ class RemoteZarrArray:
         raws = await asyncio.gather(
             *(self.store.get(self._full_key(idx)) for idx in indices)
         )
-        chunks = {
-            idx: zarr_codec.decode_chunk(self.meta, raw)
-            for idx, raw in zip(indices, raws)
-        }
+        # decode off the loop: blosc/gzip decompression is CPU-bound
+        # (and the first crc32c call may build the native lib) — on the
+        # loop it would stall every concurrent chunk fetch
+        chunks = dict(
+            zip(
+                indices,
+                await asyncio.gather(
+                    *(
+                        asyncio.to_thread(
+                            zarr_codec.decode_chunk, self.meta, raw
+                        )
+                        for raw in raws
+                    )
+                ),
+            )
+        )
         return zarr_codec.assemble(self.meta, chunks, sel)
 
     async def read_chunk(self, idx: tuple[int, ...]) -> np.ndarray:
         raw = await self.store.get(self._full_key(idx))
-        return zarr_codec.decode_chunk(self.meta, raw)
+        return await asyncio.to_thread(
+            zarr_codec.decode_chunk, self.meta, raw
+        )
 
 
 class RemoteZarrGroup:

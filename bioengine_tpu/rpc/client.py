@@ -174,7 +174,11 @@ class ServerConnection:
         connection on wire frames — never fatal."""
         store = self._shm_store_cfg
         if store == "auto":
-            store = attach_store_by_name(offer.get("name", ""))
+            # first probe may build the native lib (subprocess cc) —
+            # keep the handshake off the loop's critical path
+            store = await asyncio.to_thread(
+                attach_store_by_name, offer.get("name", "")
+            )
             self._owns_shm = store is not None
         if store is None:
             return

@@ -161,7 +161,9 @@ class RpcServer:
             return None
 
     async def start(self) -> str:
-        self._shm_store = self._resolve_shm_store()
+        # the first native-store probe may BUILD the ctypes lib
+        # (subprocess cc) — seconds of work that must not sit on the loop
+        self._shm_store = await asyncio.to_thread(self._resolve_shm_store)
         app = web.Application(client_max_size=256 * 1024 * 1024)
         app.router.add_get("/ws", self._handle_ws)
         app.router.add_get("/health/liveness", self._handle_health)

@@ -179,6 +179,9 @@ def _write_dump(snap: dict) -> None:
             None, _write_dump_sync, snap, target_dir
         )
     except RuntimeError:  # no running loop — a plain thread context
+        # this branch only runs when get_running_loop() raised, i.e.
+        # never on an event loop, so the sync write cannot stall one
+        # bioengine: ignore[BE-ASYNC-006]
         _write_dump_sync(snap, target_dir)
 
 

@@ -171,7 +171,7 @@ class BioEngineWorker:
         if dashboard.is_dir():
             self.server.register_static_dir("_dashboard", dashboard)
 
-        self._write_admin_token()
+        await asyncio.to_thread(self._write_admin_token)
         # provisioned worker_host processes join THIS control plane
         self.cluster.provisioner.set_join_info(self.server.url, self.admin_token)
         self._register_worker_service()

@@ -342,7 +342,10 @@ class WorkerHost:
         for name in compile_cache.list_entries(directory):
             if name in have or name in self._tier_published:
                 continue
-            blob = compile_cache.read_entry(name, directory)
+            # compiled-program blobs run to tens of MB — read off-loop
+            blob = await asyncio.to_thread(
+                compile_cache.read_entry, name, directory
+            )
             if blob is None:
                 continue
             try:

@@ -1,0 +1,78 @@
+"""Fixture: interprocedural async-safety (BE-ASYNC-006..008).
+
+Markers follow the flat-fixture ``# <- RULE-ID`` convention.
+"""
+
+import asyncio
+import threading
+import time
+
+
+def slow_helper():
+    time.sleep(0.5)
+
+
+def indirect_helper():
+    slow_helper()
+
+
+class Service:
+    def __init__(self):
+        self._tlock = threading.Lock()
+        self._alock = asyncio.Lock()
+        self.counter = 0
+        self.guarded = 0
+        self.loop_only = 0
+
+    # --- BE-ASYNC-006: blocking reachable through sync callees -------
+
+    async def handle(self):
+        self._sync_step()  # <- BE-ASYNC-006
+
+    def _sync_step(self):
+        indirect_helper()
+
+    async def handle_offloaded(self):
+        # function handed to a thread: not a loop-context edge
+        await asyncio.to_thread(self._sync_step)
+
+    async def handle_suppressed(self):
+        # reviewed: only runs in the CLI one-shot path
+        # bioengine: ignore[BE-ASYNC-006]
+        self._sync_step()
+
+    # --- BE-ASYNC-007: loop/thread shared mutation -------------------
+
+    def start_worker(self):
+        t = threading.Thread(target=self._worker, daemon=True)
+        t.start()
+        return t
+
+    def _worker(self):
+        self.counter += 1  # <- BE-ASYNC-007
+        with self._tlock:
+            self.guarded += 1
+
+    async def on_loop(self):
+        self.counter = 0
+        with self._tlock:
+            self.guarded = 0
+        # written on the loop only: never a finding
+        self.loop_only += 1
+
+    # --- BE-ASYNC-008: blocking lock acquisition in async ------------
+
+    async def bad_async_with(self):
+        with self._alock:  # <- BE-ASYNC-008
+            return self.counter
+
+    async def good_async_with(self):
+        async with self._alock:
+            return self.counter
+
+    async def bad_acquire(self):
+        self._tlock.acquire()  # <- BE-ASYNC-008
+        try:
+            return self.counter
+        finally:
+            self._tlock.release()

@@ -1,0 +1,30 @@
+"""Fixture: verb-call sites + capability gate sites."""
+
+from tests.analysis_fixtures.proj_demo.server_mod import (
+    PROTO_DEMO1,
+    PROTO_UNOFFERED1,
+)
+
+
+class DemoClient:
+    def __init__(self, conn):
+        self.conn = conn
+        self.peer_protocols = []
+
+    async def good_call(self):
+        # registered verb: no finding
+        return await self.conn.call("demo-service", "ping")
+
+    async def bad_call(self):
+        return await self.conn.call("demo-service", "pingg")  # <- BE-DIST-201
+
+    async def check(self, svc):
+        # attribute-call keeps the registered `describe` verb alive
+        return await svc.describe()
+
+    async def gates(self):
+        # PROTO_DEMO1 offered + gated -> in sync
+        if PROTO_DEMO1 in self.peer_protocols:
+            pass
+        # PROTO_UNOFFERED1 gated but never offered anywhere
+        return PROTO_UNOFFERED1 in self.peer_protocols

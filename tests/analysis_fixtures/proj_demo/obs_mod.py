@@ -1,0 +1,25 @@
+"""Fixture: observability-catalog + env-knob contracts (BE-DIST-204/205)."""
+
+import os
+
+from bioengine_tpu.utils import flight, metrics
+
+DOCUMENTED = metrics.counter("demo_requests_total", "in the catalog")
+UNDOCUMENTED = metrics.counter(  # <- BE-DIST-204
+    "demo_undocumented_total", "missing from the catalog"
+)
+
+
+def emit_events():
+    flight.record("demo.documented", ok=True)
+    flight.record("demo.undocumented", ok=False)  # <- BE-DIST-204
+
+
+def read_knobs():
+    a = os.environ.get("BIOENGINE_DEMO_DOCUMENTED", "1")
+    b = os.environ.get("BIOENGINE_DEMO_SECRET_KNOB")  # <- BE-DIST-205
+    c = os.environ["BIOENGINE_DEMO_SUBSCRIPT"]  # <- BE-DIST-205
+    # deliberate test-only knob
+    # bioengine: ignore[BE-DIST-205]
+    d = os.environ.get("BIOENGINE_DEMO_SUPPRESSED")
+    return a, b, c, d

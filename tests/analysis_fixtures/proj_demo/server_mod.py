@@ -1,0 +1,55 @@
+"""Fixture: verb registration + capability definition/offer sites.
+
+Project-rule markers use the same ``# <- RULE-ID`` convention as the
+flat fixtures; tests/test_analysis_project.py asserts the finding set
+equals the marker set exactly.
+"""
+
+PROTO_DEMO1 = "demo1"    # offered AND gated: in sync, no finding
+PROTO_UNGATED1 = "ungated1"  # <- BE-DIST-203 (offered, never gated)
+PROTO_UNOFFERED1 = "unoffered1"  # <- BE-DIST-203 (gated, never offered)
+
+HANDSHAKE_PROTOCOLS = [PROTO_DEMO1, PROTO_UNGATED1]
+
+
+class DemoServer:
+    def __init__(self, rpc):
+        self.rpc = rpc
+
+    def ping(self):
+        return "pong"
+
+    def describe(self):
+        return {"ok": True}
+
+    def orphan_verb(self):
+        return None
+
+    def register(self):
+        self.rpc.register_service(
+            {
+                "id": "demo-service",
+                "name": "Demo",
+                "config": {"require_context": False},
+                "ping": self.ping,
+                "describe": self.describe,
+                "orphan_verb": self.orphan_verb,  # <- BE-DIST-202
+            }
+        )
+
+
+class JustifiedServer:
+    """A deliberately-external verb suppressed at the registration."""
+
+    def external_only(self):
+        return None
+
+    def register(self, rpc):
+        rpc.register_service(
+            {
+                "id": "justified-service",
+                # external clients call this; suppression keeps it quiet
+                # bioengine: ignore[BE-DIST-202]
+                "external_only": self.external_only,
+            }
+        )

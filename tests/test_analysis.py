@@ -55,17 +55,31 @@ def test_fixture_findings_match_markers_exactly(fixture):
 
 
 def test_every_rule_has_a_seeded_fixture_violation():
-    """≥4 rules per pass, each with at least one positive marker."""
+    """Every rule has at least one positive marker: module rules in
+    the flat fx_* fixtures, project rules in the proj_demo fixture
+    tree (tests/test_analysis_project.py asserts those exactly)."""
     seeded = set()
     for f in FIXTURE_FILES:
         seeded |= {rule for rule, _ in expected_markers(f)}
-    by_pass = {"async": set(), "jax": set(), "obs": set()}
+    proj_seeded = set()
+    for f in sorted((FIXTURES / "proj_demo").rglob("*")):
+        if f.suffix in {".py", ".md"}:
+            proj_seeded |= {rule for rule, _ in expected_markers(f)}
+    by_pass: dict[str, set] = {}
     for r in all_rules():
-        assert r.id in seeded, f"no fixture seeds a violation for {r.id}"
-        by_pass[r.pass_name].add(r.id)
-    assert len(by_pass["async"]) >= 4
+        if r.project:
+            assert r.id in proj_seeded, (
+                f"no proj_demo fixture seeds a violation for {r.id}"
+            )
+        else:
+            assert r.id in seeded, (
+                f"no fixture seeds a violation for {r.id}"
+            )
+        by_pass.setdefault(r.pass_name, set()).add(r.id)
+    assert len(by_pass["async"]) >= 8  # 5 module + 3 interprocedural
     assert len(by_pass["jax"]) >= 4
     assert len(by_pass["obs"]) >= 1
+    assert len(by_pass["dist"]) >= 5
 
 
 def test_clean_fixture_is_clean():
