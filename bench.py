@@ -60,10 +60,10 @@ Env overrides:
   BENCH_TIMEOUT=N       per-attempt cap, also capped by the deadline
   BENCH_STALL=N         kill an attempt after N s with no stage output
                         (mid-stage wedge detector; default 240)
-  BENCH_CONFIGS=a,b,c   subset of vit,unet,sharded_serving,cold_start,
-                        cellpose,search,observability_overhead,
-                        scheduler_goodput,flash,unet3d,ivfpq,pqflat,
-                        rpc_transport
+  BENCH_CONFIGS=a,b,c   subset of vit,unet,sharded_serving,
+                        multihost_mesh,cold_start,cellpose,search,
+                        observability_overhead,scheduler_goodput,flash,
+                        unet3d,ivfpq,pqflat,rpc_transport
   BENCH_PROBE_CADENCE=N seconds between tunnel probes while wedged
                         (default 60)
   BENCH_REPS=N          timed reps per stage (default 2, best-of)
@@ -89,6 +89,7 @@ STAGE_COSTS = {
     "vit": 60,
     "unet": 45,
     "sharded_serving": 50,
+    "multihost_mesh": 45,
     "cold_start": 50,
     "pipeline_overlap": 60,
     "cellpose": 60,
@@ -383,6 +384,314 @@ def sharded_worker_main() -> int:
 
         jax.config.update("jax_platforms", "cpu")
     print(json.dumps(_sharded_serving_measure(cpu)), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# multihost_mesh stage: the SAME pipeline-mesh deployment spec measured
+# on a 1-host mesh vs spanning 2 simulated hosts (serving/mesh_plan.py
+# + mesh_replica.py over real in-process websockets) — images/sec both
+# legs, activation-transfer accounting, scaling efficiency, and the
+# RpcStats proof that activations rode the zero-copy OOB path.
+# ---------------------------------------------------------------------------
+
+_MESH_BENCH_MANIFEST = """\
+name: Mesh Bench
+id: mesh-bench
+id_emoji: "\U0001F578"
+description: two-stage pipeline mesh for the multihost_mesh stage
+type: tpu-serve
+version: 1.0.0
+deployments:
+  - mesh_dep:MeshDep
+authorized_users: ["*"]
+deployment_config:
+  mesh_dep:
+    num_replicas: 1
+    autoscale: false
+    mesh:
+      stages: 2
+      chips_per_stage: 2
+      kind: pipeline
+"""
+
+_MESH_BENCH_SOURCE = '''\
+import numpy as np
+
+from bioengine_tpu.rpc import schema_method
+
+N_STAGES = 2
+CHANNELS = 16
+
+
+def stage_params(stage):
+    rng = np.random.default_rng(100 + stage)
+    return {
+        "w": (rng.standard_normal((CHANNELS, CHANNELS)) * 0.2).astype(
+            np.float32
+        ),
+        "b": (rng.standard_normal((CHANNELS,)) * 0.1).astype(np.float32),
+    }
+
+
+class MeshDep:
+    async def async_init(self):
+        import jax.numpy as jnp
+
+        from bioengine_tpu.runtime.engine import (
+            InferenceEngine,
+            resolve_devices,
+        )
+
+        shard = getattr(self, "bioengine_mesh_shard", None)
+        lease = getattr(self, "bioengine_device_ids", None)
+        devices = resolve_devices(list(lease)) if lease else None
+        axes = dict(shard["axes"]) if shard else {"dp": -1}
+        stages = (
+            [int(shard["stage"])] if shard is not None else range(N_STAGES)
+        )
+        self.engines = {}
+        for k in stages:
+            last = k == N_STAGES - 1
+
+            def make_apply(last=last):
+                def apply_fn(params, x):
+                    y = x @ params["w"] + params["b"]
+                    return y if last else jnp.maximum(y, 0.0)
+
+                return apply_fn
+
+            self.engines[k] = InferenceEngine(
+                f"mesh-bench-stage-{k}",
+                make_apply(),
+                stage_params(k),
+                devices=devices,
+                mesh_axes=axes,
+            )
+
+    @schema_method
+    async def run_stage(self, stage: int, inputs, context=None):
+        """One pipeline stage's forward."""
+        return await self.engines[int(stage)].predict_async(
+            np.asarray(inputs, np.float32)
+        )
+
+    @schema_method
+    async def predict(self, inputs, context=None):
+        """Full forward (entry method the mesh driver intercepts)."""
+        x = np.asarray(inputs, np.float32)
+        for k in sorted(self.engines):
+            x = await self.engines[k].predict_async(x)
+        return x
+
+    async def close(self):
+        for engine in self.engines.values():
+            engine.close()
+'''
+
+
+def _mesh_bench_reference(x):
+    """Independent numpy forward of the bench app's 2-stage model."""
+    import numpy as np
+
+    ch = 16
+    params = []
+    for stage in range(2):
+        rng = np.random.default_rng(100 + stage)
+        params.append(
+            (
+                (rng.standard_normal((ch, ch)) * 0.2).astype(np.float32),
+                (rng.standard_normal((ch,)) * 0.1).astype(np.float32),
+            )
+        )
+    h = np.maximum(x @ params[0][0] + params[0][1], 0.0)
+    return h @ params[1][0] + params[1][1]
+
+
+def _multihost_mesh_measure(n_hosts: int) -> dict:
+    """One leg: in-process control plane (real websockets), ``n_hosts``
+    worker hosts, ONE mesh deployment from the same spec — measured
+    requests/sec plus the mesh driver's transfer accounting and the
+    server codec's OOB counters."""
+    import asyncio
+    import tempfile
+    from pathlib import Path
+
+    import numpy as np
+
+    async def run() -> dict:
+        from bioengine_tpu.apps.builder import AppBuilder
+        from bioengine_tpu.cluster.state import ClusterState
+        from bioengine_tpu.cluster.topology import TpuTopology
+        from bioengine_tpu.rpc.server import RpcServer
+        from bioengine_tpu.serving import ServeController
+        from bioengine_tpu.worker_host import WorkerHost
+
+        tmp = Path(tempfile.mkdtemp(prefix="bench-mesh-"))
+        app_dir = tmp / "src"
+        app_dir.mkdir()
+        (app_dir / "manifest.yaml").write_text(_MESH_BENCH_MANIFEST)
+        (app_dir / "mesh_dep.py").write_text(_MESH_BENCH_SOURCE)
+
+        server = RpcServer(host="127.0.0.1", admin_users=["admin"])
+        await server.start()
+        token = server.issue_token("admin", is_admin=True)
+        controller = ServeController(
+            ClusterState(TpuTopology(chips=(), n_hosts=1, platform="cpu")),
+            health_check_period=3600,
+        )
+        controller.attach_rpc(server, admin_users=["admin"])
+        hosts = []
+        try:
+            for i in range(n_hosts):
+                host = WorkerHost(
+                    server_url=server.url,
+                    token=token,
+                    host_id=f"bh{i}",
+                    workspace_dir=tmp / f"ws{i}",
+                )
+                await host.start()
+                hosts.append(host)
+            built = AppBuilder(workdir_root=tmp / "apps").build(
+                app_id="mesh-bench", local_path=app_dir
+            )
+            await controller.deploy("mesh-bench", built.specs)
+            mesh = controller.apps["mesh-bench"].replicas["mesh_dep"][0]
+            handle = controller.get_handle("mesh-bench", "mesh_dep")
+
+            batch, hw = 8, 32
+            rng = np.random.default_rng(0)
+            x = rng.standard_normal((batch, hw, hw, 16)).astype(np.float32)
+            out = np.asarray(await handle.call("predict", x))  # warmup
+            err = float(np.max(np.abs(out - _mesh_bench_reference(x))))
+
+            iters = int(os.environ.get("BENCH_MESH_ITERS", "12"))
+            reps = int(os.environ.get("BENCH_REPS", "2"))
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    await handle.call("predict", x)
+                best = min(best, time.perf_counter() - t0)
+            n_calls = reps * iters + 1  # transfer totals span every call
+            stats = mesh.engine.stats()
+            rpc = server.stats.as_dict()
+            return {
+                "n_hosts": n_hosts,
+                "batch": batch,
+                "image_hw": hw,
+                "cross_host": mesh.plan.cross_host,
+                "hosts": mesh.plan.hosts,
+                "images_per_sec": round(batch * iters / best, 2),
+                "parity_max_abs_err": err,
+                "parity_ok": bool(err < 1e-3),
+                "transfer_bytes_per_request": int(
+                    stats["transfer_bytes"] / n_calls
+                ),
+                "transfer_seconds_per_request": round(
+                    stats["transfer_seconds"] / n_calls, 6
+                ),
+                "oob_payloads_out": rpc["oob_payloads_out"],
+                "legacy_msgs_out": rpc["legacy_msgs_out"],
+            }
+        finally:
+            for host in hosts:
+                try:
+                    await host.stop()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+            await controller.stop()
+            await server.stop()
+
+    return asyncio.run(run())
+
+
+def _bench_multihost_mesh(cpu: bool) -> dict:
+    """1-host vs 2-simulated-host pipeline mesh on the SAME workload
+    and the SAME deployment spec — the topology-portability headline.
+    ``scaling_efficiency`` (2-host / 1-host images/sec) reads as the
+    cost of crossing hosts: ~1.0 means the activation hops are free
+    relative to compute; well under 1.0 means the split is
+    transfer-bound at this model size. On CPU each leg runs in its own
+    ``--multihost-worker`` subprocess under a forced 4-host-device
+    layout (the flag never touches the orchestrator's interpreter,
+    same isolation as --sharded-worker); numbers there are core-bound
+    and informational — schema, parity, and the OOB pin are the
+    contract."""
+    legs: dict[int, dict] = {}
+    for n_hosts in (1, 2):
+        if not cpu:
+            legs[n_hosts] = _multihost_mesh_measure(n_hosts)
+            continue
+        env = dict(os.environ)
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.abspath(__file__),
+                "--multihost-worker",
+                str(n_hosts),
+            ],
+            capture_output=True,
+            text=True,
+            env=env,
+            timeout=float(
+                os.environ.get("BENCH_MULTIHOST_WORKER_TIMEOUT", "240")
+            ),
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"multihost-worker({n_hosts}) rc={proc.returncode}: "
+                f"{proc.stderr[-500:]}"
+            )
+        legs[n_hosts] = json.loads(proc.stdout.strip().splitlines()[-1])
+    one, two = legs[1], legs[2]
+    speed_1, speed_2 = one["images_per_sec"], two["images_per_sec"]
+    return {
+        "batch": two["batch"],
+        "image_hw": two["image_hw"],
+        "stages": 2,
+        "images_per_sec_1host": speed_1,
+        "images_per_sec_2host": speed_2,
+        "scaling_efficiency": round(speed_2 / max(speed_1, 1e-9), 3),
+        "cross_host_overhead_ms_per_request": round(
+            (
+                two["batch"] / max(speed_2, 1e-9)
+                - one["batch"] / max(speed_1, 1e-9)
+            )
+            * 1000,
+            3,
+        ),
+        "transfer_bytes_per_request": two["transfer_bytes_per_request"],
+        "transfer_seconds_per_request": two["transfer_seconds_per_request"],
+        "cross_host_1host": one["cross_host"],
+        "cross_host_2host": two["cross_host"],
+        "parity_ok": bool(one["parity_ok"] and two["parity_ok"]),
+        "parity_max_abs_err": max(
+            one["parity_max_abs_err"], two["parity_max_abs_err"]
+        ),
+        # the zero-copy pin: activation frames were extracted into OOB
+        # scatter-gather tables (RpcStats), never legacy inline packs
+        "oob_payloads_out": two["oob_payloads_out"],
+        "legacy_msgs_out": two["legacy_msgs_out"],
+    }
+
+
+def multihost_worker_main() -> int:
+    """``bench.py --multihost-worker N``: one mesh leg (N in-process
+    hosts), own interpreter, prints one JSON line on stdout."""
+    cpu = os.environ.get("BENCH_PLATFORM", "").lower() == "cpu"
+    if cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    idx = sys.argv.index("--multihost-worker")
+    n_hosts = int(sys.argv[idx + 1])
+    print(json.dumps(_multihost_mesh_measure(n_hosts)), flush=True)
     return 0
 
 
@@ -1784,6 +2093,7 @@ def worker_main() -> int:
         "vit": _bench_vit,
         "unet": _bench_unet,
         "sharded_serving": _bench_sharded_serving,
+        "multihost_mesh": _bench_multihost_mesh,
         "cold_start": _bench_cold_start,
         "pipeline_overlap": _bench_pipeline_overlap,
         "unet3d": _bench_unet3d,
@@ -2100,6 +2410,7 @@ def _final_json(shared: _Shared, deadline_hit: bool) -> str:
             "probe": shared.stages.get("probe"),
             "unet256": shared.stages.get("unet"),
             "sharded_serving": shared.stages.get("sharded_serving"),
+            "multihost_mesh": shared.stages.get("multihost_mesh"),
             "cold_start": shared.stages.get("cold_start"),
             "pipeline_overlap": shared.stages.get("pipeline_overlap"),
             "unet3d": shared.stages.get("unet3d"),
@@ -2290,6 +2601,8 @@ def main() -> int:
         return worker_main()
     if "--sharded-worker" in sys.argv:
         return sharded_worker_main()
+    if "--multihost-worker" in sys.argv:
+        return multihost_worker_main()
     if "--cold-start-worker" in sys.argv:
         return cold_start_worker_main()
     if "--compare" in sys.argv:

@@ -29,6 +29,7 @@ from bioengine_tpu.apps.artifacts import LocalArtifactStore
 from bioengine_tpu.apps.manifest import AppManifest, load_manifest
 from bioengine_tpu.rpc.schema import is_schema_method
 from bioengine_tpu.serving.controller import DeploymentSpec
+from bioengine_tpu.serving.mesh_plan import MeshConfig
 from bioengine_tpu.serving.scheduler import SchedulingConfig
 from bioengine_tpu.serving.slo import SLOConfig
 from bioengine_tpu.serving.warm_pool import WarmPoolConfig
@@ -362,6 +363,7 @@ class AppBuilder:
             scheduling_cfg = cfg.get("scheduling")
             slo_cfg = cfg.get("slo")
             warm_pool_cfg = cfg.get("warm_pool")
+            mesh_cfg = cfg.get("mesh")
             try:
                 spec_max_batch = (
                     int(batching["max_batch"])
@@ -386,12 +388,27 @@ class AppBuilder:
                     if warm_pool_cfg
                     else None
                 )
+                mesh = (
+                    MeshConfig.from_config(dict(mesh_cfg))
+                    if mesh_cfg
+                    else None
+                )
+                if mesh is not None and warm_pool is not None:
+                    # a mesh standby's chips span hosts, so the pool's
+                    # per-host skip_hosts guard cannot protect its
+                    # promotion — reject the combo instead of promoting
+                    # a dead-sharded mesh into rotation
+                    raise ValueError(
+                        "warm_pool cannot combine with mesh "
+                        "(standby promotion is per-host; a mesh spans "
+                        "several) — drop one of the two blocks"
+                    )
             except (TypeError, ValueError) as e:
                 # every config mistake on this path fails TYPED with the
                 # deployment named — never a raw traceback
                 raise AppBuildError(
-                    f"invalid batching/scheduling/warm_pool/slo config for "
-                    f"deployment '{ref.file_stem}': {e}"
+                    f"invalid mesh/batching/scheduling/warm_pool/slo "
+                    f"config for deployment '{ref.file_stem}': {e}"
                 ) from e
             specs.append(
                 DeploymentSpec(
@@ -408,6 +425,7 @@ class AppBuilder:
                     scheduling=scheduling,
                     slo=slo,
                     warm_pool=warm_pool,
+                    mesh=mesh,
                     remote_payload={
                         **base_payload,
                         "deployment": ref.file_stem,

@@ -34,7 +34,10 @@ _DEPLOYMENT_RE = re.compile(r"^([A-Za-z_][\w\-/]*):([A-Za-z_]\w*)$")
 # objective + percentile, availability target, window); ``warm_pool``
 # keeps N pre-started standby replicas that absorb scale-up and
 # preemption by promotion (validated in depth by
-# serving.warm_pool.WarmPoolConfig.from_config at build time).
+# serving.warm_pool.WarmPoolConfig.from_config at build time);
+# ``mesh`` places one logical replica across several hosts' chip
+# leases — pipeline/dp/tp shards for checkpoints bigger than one lease
+# (validated in depth by serving.mesh_plan.MeshConfig.from_config).
 _BATCHING_KEYS = {"max_batch", "max_wait_ms"}
 
 
@@ -128,6 +131,12 @@ def validate_manifest(data: dict[str, Any]) -> AppManifest:
             raise ManifestError(
                 f"deployment_config.{dep_name}.warm_pool must be a "
                 f"mapping, got {type(warm_pool).__name__}"
+            )
+        mesh = cfg.get("mesh")
+        if mesh is not None and not isinstance(mesh, dict):
+            raise ManifestError(
+                f"deployment_config.{dep_name}.mesh must be a "
+                f"mapping, got {type(mesh).__name__}"
             )
     return AppManifest(
         name=str(data["name"]),

@@ -164,6 +164,34 @@ def _cold_start_lines(status: dict) -> list[str]:
     return lines
 
 
+def _mesh_lines(status: dict) -> list[str]:
+    """One line per cross-host mesh replica: kind x stages, the hosts
+    each shard landed on, and the cross-shard transfer rate — one
+    logical deployment over several hosts, readable at a glance."""
+    lines: list[str] = []
+    apps = status if "deployments" not in status else {"": status}
+    for app_id, st in apps.items():
+        for name, dep in (st.get("deployments") or {}).items():
+            for rid, mesh in (dep.get("cross_host_mesh") or {}).items():
+                shards = mesh.get("shards") or []
+                placed = ", ".join(
+                    f"s{s['stage']}@{s['host_id']}"
+                    f"({len(s.get('device_ids') or [])}ch)"
+                    for s in shards
+                )
+                transfer = mesh.get("transfer") or {}
+                rate = transfer.get("transfer_bytes_per_sec")
+                lines.append(
+                    f"{app_id + '/' if app_id else ''}{name} {rid}: "
+                    f"{mesh.get('kind')} mesh {mesh.get('mesh_shape')} "
+                    f"{'cross-host' if mesh.get('cross_host') else 'one host'}"
+                    f" [{placed}]  transfer "
+                    f"{transfer.get('transfer_bytes', 0)}B"
+                    + (f" @ {rate / 1e6:.1f}MB/s" if rate else "")
+                )
+    return lines
+
+
 @apps_group.command("status")
 @click.argument("app_id", required=False)
 @server_options
@@ -173,7 +201,10 @@ def status_command(app_id, server_url, token):
         with_worker(server_url, token, lambda w: w.get_app_status(app_id=app_id))
     )
     cold = _cold_start_lines(result if isinstance(result, dict) else {})
+    mesh = _mesh_lines(result if isinstance(result, dict) else {})
     human = json.dumps(result, indent=2, default=str)
+    if mesh:
+        human = "mesh:\n" + "\n".join(mesh) + "\n\n" + human
     if cold:
         human = "cold-start:\n" + "\n".join(cold) + "\n\n" + human
     emit(result, human=human)

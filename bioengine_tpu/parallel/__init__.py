@@ -1,4 +1,4 @@
-from bioengine_tpu.parallel.mesh import MeshSpec, make_mesh
+from bioengine_tpu.parallel.mesh import MeshSpec, VirtualMeshSpec, make_mesh
 from bioengine_tpu.parallel.tensor_parallel import (
     CONV_TP_RULES,
     VIT_TP_RULES,
@@ -9,6 +9,7 @@ from bioengine_tpu.parallel.tensor_parallel import (
 
 __all__ = [
     "MeshSpec",
+    "VirtualMeshSpec",
     "make_mesh",
     "CONV_TP_RULES",
     "VIT_TP_RULES",

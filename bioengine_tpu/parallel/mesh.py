@@ -53,6 +53,41 @@ class MeshSpec:
         return sizes
 
 
+@dataclasses.dataclass(frozen=True)
+class VirtualMeshSpec:
+    """Hardware-neutral mesh description for a whole DEPLOYMENT.
+
+    The virtual-device layer (VirtualFlow's decoupling, PAPERS.md): a
+    deployment declares ``stages`` (the cross-host pipeline axis — each
+    stage placeable on a different host's chip lease) and per-stage
+    ``axes`` (dp/tp over whatever chips that stage's lease resolves to,
+    ``-1`` = fill). The SAME spec then maps onto a v5e-1, a v5e-8, a
+    two-host mesh, or a forced-host-device CPU mesh: the planner
+    (serving/mesh_plan.py) picks hosts, and each shard's engine resolves
+    ``axes`` over its concrete lease via :meth:`stage_axes` — app code
+    never names a topology.
+    """
+
+    stages: int = 1
+    axes: Mapping[str, int] = dataclasses.field(
+        default_factory=lambda: {"dp": -1}
+    )
+
+    def stage_axes(self, n_devices: int) -> dict[str, int]:
+        """Resolve the per-stage axes over one stage's concrete lease."""
+        return MeshSpec(dict(self.axes)).resolve(n_devices)
+
+    def shape(self, n_devices_per_stage: int) -> dict[str, int]:
+        """The logical mesh shape this spec yields on a concrete
+        topology — ``pp`` (pipeline/stage axis) first, then the
+        resolved per-stage axes."""
+        out: dict[str, int] = {}
+        if self.stages > 1:
+            out["pp"] = self.stages
+        out.update(self.stage_axes(n_devices_per_stage))
+        return out
+
+
 def make_mesh(
     axes: Mapping[str, int],
     devices: Optional[Sequence[jax.Device]] = None,

@@ -77,7 +77,12 @@ class ServerConnection:
         # capabilities declared at handshake; [] forces pure-legacy
         # framing in BOTH directions (bench baseline, interop tests)
         self.protocols = (
-            [protocol.PROTO_OOB1, protocol.PROTO_TRACE1, protocol.PROTO_TELEM1]
+            [
+                protocol.PROTO_OOB1,
+                protocol.PROTO_TRACE1,
+                protocol.PROTO_TELEM1,
+                protocol.PROTO_MESH1,
+            ]
             if protocols is None
             else list(protocols)
         )

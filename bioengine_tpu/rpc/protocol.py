@@ -69,6 +69,8 @@ CHUNK_MAGIC = b"BEC1"          # one chunk of an oversized frame
 PROTO_OOB1 = "oob1"            # negotiated capability name
 PROTO_TRACE1 = "trace1"        # request-trace fields on CALL/RESULT
 PROTO_TELEM1 = "telem1"        # push-telemetry verbs on the serve-router
+PROTO_MESH1 = "mesh1"          # cross-host mesh shards (mesh_shard on
+                               # start_replica, stage activations over OOB)
 
 EXT_NDARRAY = 1                # legacy inline array (double-packed)
 EXT_EXCEPTION = 2
@@ -235,17 +237,26 @@ def _ref_for(
     return msgpack.ExtType(EXT_OOB_REF, msgpack.packb({**desc, "i": idx}))
 
 
-def encode_oob(msg: dict, shm_put: Optional[Callable] = None) -> bytearray:
+def encode_oob(
+    msg: dict,
+    shm_put: Optional[Callable] = None,
+    payload_info: Optional[dict] = None,
+) -> bytearray:
     """Encode ``msg`` as one scatter-gather frame.
 
     Each extracted payload buffer is written into the frame exactly
     once (or diverted to the shared store via ``shm_put``); everything
     else packs into the small header. Returns the assembled frame —
-    ``bytearray`` so callers can send slices without another copy."""
+    ``bytearray`` so callers can send slices without another copy.
+    ``payload_info`` (when given) receives ``{"n", "bytes"}`` of the
+    wire-extracted buffers — the codec's RpcStats feed."""
     buffers: list[memoryview] = []
     header = msgpack.packb(
         _extract(msg, buffers, shm_put), default=_default, use_bin_type=True
     )
+    if payload_info is not None:
+        payload_info["n"] = len(buffers)
+        payload_info["bytes"] = sum(b.nbytes for b in buffers)
     table = []
     rel = 0
     for buf in buffers:

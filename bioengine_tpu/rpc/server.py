@@ -124,6 +124,9 @@ class RpcServer:
         self.transport_config = transport_config or TransportConfig.from_env()
         self.stats = RpcStats()
         self._client_codecs: dict[str, Codec] = {}
+        # capability sets each ws client declared at its handshake
+        # (oob/trace live on the codec; the rest are looked up here)
+        self._client_protos: dict[str, frozenset[str]] = {}
         self._shm_store_cfg = shm_store
         self._shm_store: Any = None
         self._shm_nonces: dict[str, tuple[str, bytes]] = {}  # client -> (key, nonce)
@@ -317,6 +320,22 @@ class RpcServer:
 
     def unregister_service(self, full_id: str) -> None:
         self._services.pop(full_id, None)
+
+    def service_peer_supports(self, full_id: str, capability: str) -> bool:
+        """Did the ws client that OWNS ``full_id`` declare ``capability``
+        at its handshake? In-process services (owner_client None) share
+        this process's code and support everything we do. The mesh
+        planner gates cross-host shard placement on this — a legacy
+        worker host that never declared ``mesh1`` must not be handed a
+        ``mesh_shard`` start it cannot honor."""
+        entry = self._services.get(full_id)
+        if entry is None:
+            return False
+        if entry.owner_client is None:
+            return True
+        return capability in self._client_protos.get(
+            entry.owner_client, frozenset()
+        )
 
     def list_services(self, workspace: Optional[str] = None) -> list[dict]:
         out = []
@@ -608,6 +627,11 @@ class RpcServer:
         codec.oob = protocol.PROTO_OOB1 in declared
         codec.trace = protocol.PROTO_TRACE1 in declared
         self._clients[client_id] = ws
+        # the full declared set outlives the codec flags: server-side
+        # capability gates (e.g. the controller refusing to plan a
+        # cross-host mesh onto a pre-mesh1 host) ask via
+        # service_peer_supports
+        self._client_protos[client_id] = frozenset(p for p in declared if p)
         self._client_users[client_id] = info
         self._client_codecs[client_id] = codec
         welcome = {
@@ -619,6 +643,7 @@ class RpcServer:
                 protocol.PROTO_OOB1,
                 protocol.PROTO_TRACE1,
                 protocol.PROTO_TELEM1,
+                protocol.PROTO_MESH1,
             ],
         }
         if codec.oob and self._shm_store is not None:
@@ -659,6 +684,7 @@ class RpcServer:
     def _drop_client(self, client_id: str) -> None:
         self._clients.pop(client_id, None)
         self._client_users.pop(client_id, None)
+        self._client_protos.pop(client_id, None)
         codec = self._client_codecs.pop(client_id, None)
         if codec is not None:
             codec.close()

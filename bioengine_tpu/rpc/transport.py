@@ -113,6 +113,12 @@ class RpcStats:
     shm_get_bytes: int = 0
     shm_fallbacks: int = 0       # store absent/full -> wire frame
     legacy_msgs_out: int = 0     # peers without oob1
+    # payloads extracted into BEF1 scatter-gather tables on encode (the
+    # wire half of the zero-copy path; shm_puts is the same-host half).
+    # What the cross-host mesh tests PIN: activation arrays between
+    # shards must land here, never as legacy inline double-packs.
+    oob_payloads_out: int = 0
+    oob_payload_bytes_out: int = 0
 
     def __post_init__(self) -> None:
         # every live stats object feeds the process-wide metrics plane
@@ -138,6 +144,7 @@ _RPC_METRIC_FIELDS = (
     "frames_in", "chunked_msgs_out", "chunked_msgs_in", "encode_seconds",
     "decode_seconds", "shm_puts", "shm_put_bytes", "shm_gets",
     "shm_get_bytes", "shm_fallbacks", "legacy_msgs_out",
+    "oob_payloads_out", "oob_payload_bytes_out",
 )
 
 
@@ -425,16 +432,21 @@ class Codec:
     def encode_frames(self, msg: dict) -> list:
         """Encode ``msg`` into the list of websocket messages to send."""
         t0 = time.perf_counter()
+        payload_info: dict = {}
         if not self.oob:
             frames = [protocol.encode(msg)]
         else:
-            frame = protocol.encode_oob(msg, shm_put=self._shm_put)
+            frame = protocol.encode_oob(
+                msg, shm_put=self._shm_put, payload_info=payload_info
+            )
             frames = chunk_frames(frame, self.config.frame_limit)
         with self.stats.lock:
             if not self.oob:
                 self.stats.legacy_msgs_out += 1
             elif len(frames) > 1:
                 self.stats.chunked_msgs_out += 1
+            self.stats.oob_payloads_out += payload_info.get("n", 0)
+            self.stats.oob_payload_bytes_out += payload_info.get("bytes", 0)
             self.stats.encode_seconds += time.perf_counter() - t0
             self.stats.msgs_out += 1
             self.stats.frames_out += len(frames)

@@ -40,7 +40,7 @@ import os
 import threading
 import time
 import warnings
-from typing import Any, Callable, Optional, Sequence
+from typing import Any, Callable, Mapping, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -188,6 +188,7 @@ class InferenceEngine:
         device_ids: Optional[Sequence[int]] = None,
         tp: int = 1,
         tp_rules: Optional[Sequence] = None,
+        mesh_axes: Optional[Mapping[str, int]] = None,
     ):
         self.model_id = model_id
         self.apply_fn = apply_fn
@@ -205,6 +206,34 @@ class InferenceEngine:
         else:
             self.devices = [device or jax.devices()[0]]
         n = len(self.devices)
+        if mesh_axes is not None and int(tp) > 1:
+            # two sources of truth for the tp width would silently
+            # shadow each other (a caller asking tp=2 because the
+            # params outgrow one chip must not get a dp-only engine)
+            raise ValueError(
+                "pass tp inside mesh_axes (e.g. {'dp': -1, 'tp': 2}) "
+                "or as the tp= argument — not both"
+            )
+        if mesh_axes is not None:
+            # virtual-device layer: a hardware-neutral axes spec
+            # ({"dp": -1}, {"dp": -1, "tp": 2}, ...) resolved over
+            # whatever chip group THIS engine actually got — the same
+            # deployment spec compiles for a 1-chip lease, a v5e-8, or
+            # a forced-host-device CPU mesh without code changes
+            # (parallel/mesh.py VirtualMeshSpec.stage_axes is the same
+            # resolution the cross-host planner applies per stage)
+            from bioengine_tpu.parallel.mesh import MeshSpec
+
+            sizes = MeshSpec(dict(mesh_axes)).resolve(n)
+            unknown = sorted(set(sizes) - {"dp", "tp"})
+            if unknown:
+                raise ValueError(
+                    f"mesh_axes names unsupported engine axes {unknown} "
+                    "(an InferenceEngine shards batches over 'dp' and "
+                    "weights over 'tp'; pipeline stages live ABOVE the "
+                    "engine, in the cross-host plan)"
+                )
+            tp = sizes.get("tp", 1)
         self.tp = max(int(tp), 1)
         if n % self.tp:
             raise ValueError(

@@ -13,6 +13,13 @@ from bioengine_tpu.serving.errors import (
     ReplicaUnavailableError,
     RetryableTransportError,
 )
+from bioengine_tpu.serving.mesh_plan import (
+    MeshConfig,
+    MeshPlan,
+    MeshPlanError,
+    plan_mesh,
+)
+from bioengine_tpu.serving.mesh_replica import CrossHostEngine, MeshReplica
 from bioengine_tpu.serving.replica import Replica, ReplicaState
 from bioengine_tpu.serving.scheduler import (
     DeploymentScheduler,
@@ -28,12 +35,18 @@ __all__ = [
     "AdmissionRejectedError",
     "ApplicationError",
     "ContinuousBatcher",
+    "CrossHostEngine",
     "DeadlineExceeded",
     "DeploymentHandle",
     "DeploymentScheduler",
     "DeploymentSpec",
     "HeuristicCostModel",
     "LoadPredictor",
+    "MeshConfig",
+    "MeshPlan",
+    "MeshPlanError",
+    "MeshReplica",
+    "plan_mesh",
     "NoHealthyReplicasError",
     "Replica",
     "ReplicaState",

@@ -26,7 +26,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from bioengine_tpu.cluster.state import ClusterState
-from bioengine_tpu.rpc.protocol import RemoteError
+from bioengine_tpu.rpc.protocol import PROTO_MESH1, RemoteError
 from bioengine_tpu.serving.errors import (
     AdmissionRejectedError,
     DeadlineExceeded,
@@ -37,6 +37,12 @@ from bioengine_tpu.serving.errors import (
     classify_exception,
     is_caller_timeout,
 )
+from bioengine_tpu.serving.mesh_plan import (
+    MeshConfig,
+    MeshPlanError,
+    plan_mesh,
+)
+from bioengine_tpu.serving.mesh_replica import MeshReplica
 from bioengine_tpu.serving.remote import RemoteReplica
 from bioengine_tpu.serving.scheduler import (
     DeploymentScheduler,
@@ -237,6 +243,11 @@ class DeploymentSpec:
     # pre-started out-of-rotation replicas that absorb scale-up and
     # preemption by PROMOTION instead of a cold start; None = no pool
     warm_pool: Optional[WarmPoolConfig] = None
+    # multi-host mesh placement (manifest mesh: block): one logical
+    # replica whose pipeline/dp/tp shards span several hosts' chip
+    # leases (serving/mesh_plan.py) — the path for checkpoints bigger
+    # than any single host's lease; None = single-host replicas
+    mesh: Optional[MeshConfig] = None
 
     def batch_config(self) -> Optional[dict]:
         if self.max_batch is None and self.max_wait_ms is None:
@@ -945,19 +956,34 @@ class ServeController:
             raise
         return app
 
-    async def _add_replica(self, app: AppDeployment, spec: DeploymentSpec):
+    async def _add_replica(
+        self,
+        app: AppDeployment,
+        spec: DeploymentSpec,
+        avoid_hosts: Any = (),
+    ):
         """Place one replica: locally when this host has the chips, else
         on a joined worker host with capacity (RPC-backed RemoteReplica),
-        else enqueue a pending workload for the provisioner."""
+        else enqueue a pending workload for the provisioner.
+        ``avoid_hosts`` steers a mesh re-plan around hosts the replaced
+        replica degraded on (dead hosts are excluded anyway; this
+        covers alive-but-faulty ones)."""
         from bioengine_tpu.utils.tracing import span
 
         with span(
             "add_replica", app_id=app.app_id, deployment=spec.name,
             chips=spec.chips_per_replica,
         ):
-            return await self._add_replica_inner(app, spec)
+            return await self._add_replica_inner(
+                app, spec, avoid_hosts=avoid_hosts
+            )
 
-    async def _add_replica_inner(self, app: AppDeployment, spec: DeploymentSpec):
+    async def _add_replica_inner(
+        self,
+        app: AppDeployment,
+        spec: DeploymentSpec,
+        avoid_hosts: Any = (),
+    ):
         # warm-pool fast path: a scale-up or preemption restart PROMOTES
         # a pre-started standby (instance built, weights resident,
         # programs warm) instead of paying the cold start — the pool
@@ -995,7 +1021,9 @@ class ServeController:
                         logger=self.logger,
                     )
                 return promoted
-        replica = await self._place_new_replica(app, spec)
+        replica = await self._place_new_replica(
+            app, spec, avoid_hosts=avoid_hosts
+        )
         app.replicas[spec.name].append(replica)
         self.cluster_state.remove_pending(f"{app.app_id}/{spec.name}")
         self._replicas_changed.set()  # wake requests parked in _pick_replica_wait
@@ -1015,6 +1043,7 @@ class ServeController:
         spec: DeploymentSpec,
         pending_on_fail: bool = True,
         record_failed: bool = True,
+        avoid_hosts: Any = (),
     ):
         """Place and START one replica (local chips → joined host →
         pending workload) WITHOUT adding it to the routing set — shared
@@ -1022,6 +1051,11 @@ class ServeController:
         ``record_failed`` keeps the legacy behavior of surfacing a
         start-failed replica in app.replicas (the health loop retires
         it); pool fills opt out — a failed standby just isn't a standby."""
+        if spec.mesh is not None:
+            return await self._place_mesh_replica(
+                app, spec, pending_on_fail=pending_on_fail,
+                record_failed=record_failed, avoid_hosts=avoid_hosts,
+            )
         replica = None
         host_id = None
         if spec.chips_per_replica > 0 and (
@@ -1066,6 +1100,101 @@ class ServeController:
         try:
             await replica.start()
         except Exception:
+            self.cluster_state.mark_replica_dead(replica.replica_id)
+            if record_failed:
+                app.replicas[spec.name].append(replica)
+            raise
+        return replica
+
+    # ---- multi-host mesh placement ------------------------------------------
+
+    def _mesh_capable_hosts(self) -> list:
+        """Alive hosts whose connection declared the ``mesh1``
+        capability at its handshake — a legacy host that cannot honor a
+        ``mesh_shard`` start is never planned onto."""
+        if self._rpc_server is None:
+            return []
+        return [
+            h
+            for h in self.cluster_state.hosts.values()
+            if h.alive
+            and self._rpc_server.service_peer_supports(
+                h.service_id, PROTO_MESH1
+            )
+        ]
+
+    async def _place_mesh_replica(
+        self,
+        app: AppDeployment,
+        spec: DeploymentSpec,
+        pending_on_fail: bool = True,
+        record_failed: bool = True,
+        avoid_hosts: Any = (),
+    ):
+        """Place one LOGICAL replica across several hosts' leases:
+        plan (policy — serving/mesh_plan.py, scored through the same
+        ``scorer_factory`` contract as scheduler placement), lease
+        every shard's chips under the mesh replica's own id (so
+        ``mark_replica_dead`` releases the whole mesh), then start the
+        shards (execution — serving/mesh_replica.py). A restart after a
+        host death lands here again and re-plans over the survivors —
+        collapsing to a single-host fallback mesh when the config
+        allows it."""
+        if spec.remote_payload is None:
+            raise MeshPlanError(
+                f"{app.app_id}/{spec.name}: mesh placement needs a "
+                f"remote payload (shards are built on worker hosts)"
+            )
+        try:
+            plan = plan_mesh(
+                spec.mesh,
+                self._mesh_capable_hosts(),
+                self.scorer_factory(),
+                avoid_hosts=avoid_hosts,
+            )
+        except MeshPlanError as e:
+            if pending_on_fail:
+                # the provisioner's scale-up signal carries the chip
+                # bill the PLANNER computed (the whole mesh, not one
+                # host's slice — and a future partial-plan raise can
+                # bill only the remainder)
+                self.cluster_state.add_pending(
+                    f"{app.app_id}/{spec.name}",
+                    {"chips": e.chips_needed or spec.mesh.total_chips},
+                )
+            raise
+        replica = MeshReplica(
+            app_id=app.app_id,
+            deployment_name=spec.name,
+            plan=plan,
+            call_host=self._call_host,
+            payload=spec.remote_payload,
+            max_ongoing_requests=spec.max_ongoing_requests,
+            log_sink=self.cluster_state.append_replica_log,
+        )
+        for shard in plan.shards:
+            shard.device_ids = self.cluster_state.host_acquire_chips(
+                shard.host_id, replica.replica_id, shard.n_chips
+            )
+        replica.device_ids = [
+            d for s in plan.shards for d in s.device_ids
+        ]
+        self.cluster_state.register_replica(
+            app.app_id,
+            spec.name,
+            replica.replica_id,
+            replica.device_ids,
+            host_id=replica.host_id,
+        )
+        self.logger.info(
+            f"placing {app.app_id}/{spec.name} as a "
+            f"{spec.mesh.kind} x{spec.mesh.stages} mesh over "
+            f"{plan.hosts} (chips {replica.device_ids})"
+        )
+        try:
+            await replica.start()
+        except Exception:
+            # every shard lease rides the mesh replica id — one release
             self.cluster_state.mark_replica_dead(replica.replica_id)
             if record_failed:
                 app.replicas[spec.name].append(replica)
@@ -1183,6 +1312,12 @@ class ServeController:
             if r.replica_id != info.get("replica_id"):
                 continue
             if not getattr(r, "is_remote", False) or r.host_id != host_id:
+                return False
+            if getattr(r, "is_mesh", False):
+                # a mesh's identity spans hosts (and its inventory rows
+                # carry shard ids, not the mesh id — this branch is a
+                # belt under that suspender): one rejoining host can
+                # never re-adopt it; the re-plan owns recovery
                 return False
             try:
                 reported = ReplicaState(info.get("state", ""))
@@ -1492,7 +1627,17 @@ class ServeController:
                 if r in replicas:
                     replicas.remove(r)
                 try:
-                    await self._add_replica(app, spec)
+                    # a mesh replica remembers WHICH hosts its shards
+                    # failed on — steer the re-plan around them (a dead
+                    # host is excluded anyway; this covers the
+                    # alive-but-faulty one, scored last-resort)
+                    await self._add_replica(
+                        app,
+                        spec,
+                        avoid_hosts=frozenset(
+                            getattr(r, "degraded_hosts", ()) or ()
+                        ),
+                    )
                     self._replicas_changed.set()
                 except Exception as e:
                     self.logger.error(
@@ -1756,6 +1901,23 @@ class ServeController:
                 for d in described
                 if d.get("mesh")
             },
+            # one-logical-deployment-over-many-hosts view: per-replica
+            # shard placement + the cross-shard transfer rate (the
+            # number that says whether the pipeline split is
+            # transfer-bound); None when no replica is a mesh
+            "cross_host_mesh": {
+                d["replica_id"]: {
+                    "kind": (d["mesh"] or {}).get("kind"),
+                    "mesh_shape": (d["mesh"] or {}).get("mesh_shape"),
+                    "cross_host": (d["mesh"] or {}).get("cross_host"),
+                    "hosts": (d["mesh"] or {}).get("hosts"),
+                    "shards": (d["mesh"] or {}).get("shards"),
+                    "transfer": (d["mesh"] or {}).get("transfer"),
+                }
+                for d in described
+                if (d.get("mesh") or {}).get("shards") is not None
+            }
+            or None,
         }
 
     # ---- telemetry / SLO surfaces -------------------------------------------

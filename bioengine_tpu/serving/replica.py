@@ -118,6 +118,7 @@ class Replica(ReplicaStateMixin):
         log_sink: Optional[Callable[[str, str], None]] = None,
         drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S,
         batch_config: Optional[dict] = None,
+        mesh_shard: Optional[dict] = None,
     ):
         self.app_id = app_id
         self.deployment_name = deployment_name
@@ -127,6 +128,7 @@ class Replica(ReplicaStateMixin):
         self.max_ongoing_requests = max_ongoing_requests
         self.drain_timeout_s = drain_timeout_s
         self.batch_config = dict(batch_config) if batch_config else None
+        self.mesh_shard = dict(mesh_shard) if mesh_shard else None
         self._instance_factory = instance_factory
         self.instance: Any = None
         self._semaphore = asyncio.Semaphore(max_ongoing_requests)
@@ -214,6 +216,23 @@ class Replica(ReplicaStateMixin):
                     self._log(
                         f"could not inject batch config "
                         f"{self.batch_config} into instance ({e})"
+                    )
+            if self.mesh_shard:
+                # cross-host mesh placement (serving/mesh_plan.py): tell
+                # the instance WHICH slice of the model this replica
+                # holds ({stage, n_stages, kind, axes}) before
+                # async_init — same injection contract as the device
+                # lease, so a shard builds only its stage's engine and
+                # params over its own chips
+                try:
+                    self.instance.bioengine_mesh_shard = dict(
+                        self.mesh_shard
+                    )
+                except Exception as e:  # noqa: BLE001 — slots/frozen instances opt out
+                    self._log(
+                        f"could not inject mesh shard {self.mesh_shard} "
+                        f"into instance ({e}); replica will build the "
+                        f"full model"
                     )
             if hasattr(self.instance, "async_init"):
                 await _maybe_await(self.instance.async_init())

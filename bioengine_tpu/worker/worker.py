@@ -503,6 +503,34 @@ class BioEngineWorker:
                 if action == "start" and trace_dir
                 else {}
             )
+            if getattr(replica, "is_mesh", False):
+                # a mesh replica spans hosts; jax.profiler is
+                # process-global per host, so profile every shard host
+                # (deduped — a single-host fallback mesh has one) and
+                # return the per-host results keyed by host_id
+                shard_hosts = {
+                    s.host_id: s.service_id for s in replica.plan.shards
+                }
+
+                async def one_host(service_id: str) -> dict:
+                    # bounded + isolated: a wedged shard host (the
+                    # degraded one, usually) costs its own 30 s, never
+                    # the default 300 s RPC timeout, and never the
+                    # live hosts' profiling data mid-incident
+                    try:
+                        return await self.controller._call_host(
+                            service_id, verb, rpc_timeout=30.0, **kwargs
+                        )
+                    except Exception as e:  # noqa: BLE001 — partial profile beats none
+                        return {"error": f"{type(e).__name__}: {e}"}
+
+                gathered = await asyncio.gather(
+                    *(one_host(sid) for sid in shard_hosts.values())
+                )
+                return {
+                    **target,
+                    "hosts": dict(zip(shard_hosts, gathered)),
+                }
             result = await self.controller._call_host(
                 replica.host_service_id, verb, **kwargs
             )

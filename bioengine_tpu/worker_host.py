@@ -501,6 +501,7 @@ class WorkerHost:
         payload: dict,
         device_ids: Optional[list[int]] = None,
         max_ongoing_requests: int = 10,
+        mesh_shard: Optional[dict] = None,
     ) -> dict:
         """Build the deployment instance from the shipped artifact
         payload and run the standard replica lifecycle chain."""
@@ -509,6 +510,19 @@ class WorkerHost:
 
         if faults.ACTIVE:
             await faults.hit("host.start_replica")
+
+        if mesh_shard is not None and not (
+            self.connection is not None
+            and self.connection.peer_supports(protocol.PROTO_MESH1)
+        ):
+            # a mesh shard only makes sense under a controller that
+            # speaks the mesh1 contract (it drives our stage calls and
+            # owns the cross-shard composition) — refuse loudly rather
+            # than serve a partial model as if it were whole
+            raise RuntimeError(
+                f"host '{self.host_id}' was handed a mesh_shard but the "
+                f"control plane never negotiated '{protocol.PROTO_MESH1}'"
+            )
 
         # tier entries published since our join (another host's compile
         # of the same model) turn this replica's compiles into disk
@@ -547,6 +561,7 @@ class WorkerHost:
             # re-derives the same spec, so remote replicas honor them
             # identically to local ones
             batch_config=spec.batch_config(),
+            mesh_shard=mesh_shard,
         )
         replica.replica_id = replica_id  # controller's id IS the identity
         try:

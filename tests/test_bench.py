@@ -118,6 +118,59 @@ def test_sharded_serving_stage_schema():
     assert st["parity_ok"], st["parity_max_abs_err"]
 
 
+def test_multihost_mesh_stage_schema():
+    """Pin the multihost_mesh artifact schema: the SAME 2-stage
+    pipeline-mesh deployment spec measured on a 1-host mesh vs
+    spanning 2 simulated hosts (each leg its own --multihost-worker
+    subprocess under a forced 4-device CPU layout). CPU throughput is
+    core-bound and informational; the contract is the schema, output
+    parity on both legs, and the RpcStats pin that cross-shard
+    activation payloads rode the zero-copy OOB path."""
+    proc, lines = _run(
+        {
+            "BENCH_CONFIGS": "multihost_mesh",
+            "BENCH_DEADLINE": "170",
+        },
+        timeout=200.0,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    st = json.loads(lines[-1])["extra"]["multihost_mesh"]
+    assert st["ok"], st
+    for key in (
+        "batch",
+        "image_hw",
+        "stages",
+        "images_per_sec_1host",
+        "images_per_sec_2host",
+        "scaling_efficiency",
+        "cross_host_overhead_ms_per_request",
+        "transfer_bytes_per_request",
+        "transfer_seconds_per_request",
+        "cross_host_1host",
+        "cross_host_2host",
+        "parity_ok",
+        "parity_max_abs_err",
+        "oob_payloads_out",
+        "legacy_msgs_out",
+    ):
+        assert key in st, key
+    assert st["stages"] == 2
+    assert st["images_per_sec_1host"] > 0
+    assert st["images_per_sec_2host"] > 0
+    assert st["scaling_efficiency"] > 0
+    # one leg colocates (1 host joined), the other spans hosts —
+    # the same spec, two topologies
+    assert st["cross_host_1host"] is False
+    assert st["cross_host_2host"] is True
+    # both legs ran the same inputs: outputs must agree with the model
+    assert st["parity_ok"], st["parity_max_abs_err"]
+    # cross-shard activations moved per request…
+    assert st["transfer_bytes_per_request"] > 0
+    # …and demonstrably as extracted OOB payloads, never legacy packs
+    assert st["oob_payloads_out"] > 0
+    assert st["legacy_msgs_out"] == 0
+
+
 def test_cold_start_stage_schema():
     """Pin the cold_start artifact schema: replica TTFR on the
     model-runner path across three legs — cold (fresh process, empty

@@ -1,0 +1,961 @@
+"""Topology-portable multi-host meshes (serving/mesh_plan.py +
+serving/mesh_replica.py).
+
+The in-process harness pattern of tests/test_chaos.py: an RpcServer, a
+ServeController, and WorkerHost instances share one event loop but
+speak over REAL websockets, so a 2-host pipeline mesh exercises the
+actual wire path — activation arrays between shards ride the PR 3
+zero-copy OOB frames (pinned against RpcStats, not assumed), killing a
+shard host is severing its websocket, and chip accounting is the real
+ClusterState ledger.
+
+Parity contract: a pipeline mesh composes ``run_stage(0..N-1)`` on
+per-host InferenceEngines; the single-host baseline composes the same
+stages in one process. Everything runs f32 on the CPU backend, so the
+pinned tolerance is rtol=1e-4 / atol=1e-5 (XLA fusion may re-associate
+float ops across the jit boundary; anything looser than that is a
+wiring bug). The same tolerance is documented in
+docs/parallelism-guide.md.
+"""
+
+import asyncio
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from bioengine_tpu.apps.builder import AppBuildError, AppBuilder
+from bioengine_tpu.cluster.state import ClusterState
+from bioengine_tpu.cluster.topology import TpuTopology
+from bioengine_tpu.rpc.server import RpcServer
+from bioengine_tpu.serving import (
+    MeshConfig,
+    MeshPlanError,
+    RequestOptions,
+    ServeController,
+    plan_mesh,
+)
+from bioengine_tpu.serving.mesh_replica import CrossHostEngine, MeshReplica
+from bioengine_tpu.serving.replica import ReplicaState
+from bioengine_tpu.serving.scheduler import HeuristicCostModel
+from bioengine_tpu.utils import flight
+from bioengine_tpu.worker_host import WorkerHost
+
+pytestmark = [pytest.mark.integration, pytest.mark.anyio]
+
+# ---------------------------------------------------------------------------
+# the mesh test app: a 2-stage channel-mixing model. Each mesh shard
+# builds ONLY its stage's InferenceEngine over its leased chips, with
+# the hardware-neutral axes spec resolved over the concrete lease
+# (engine mesh_axes — the virtual-device layer).
+# ---------------------------------------------------------------------------
+
+N_STAGES = 2
+CHANNELS = 8
+
+MESH_MANIFEST = """\
+name: Mesh App
+id: mesh-app
+id_emoji: "\U0001F578"
+description: two-stage pipeline mesh over worker hosts
+type: tpu-serve
+version: 1.0.0
+deployments:
+  - mesh_dep:MeshDep
+authorized_users: ["*"]
+deployment_config:
+  mesh_dep:
+    num_replicas: 1
+    min_replicas: 1
+    max_replicas: 1
+    autoscale: false
+    mesh:
+      stages: 2
+      chips_per_stage: 2
+      kind: pipeline
+"""
+
+SCHEDULED_MESH_MANIFEST = MESH_MANIFEST + """\
+    scheduling:
+      enabled: true
+      max_batch: 4
+      max_wait_ms: 5
+"""
+
+MESH_APP_SOURCE = '''\
+import numpy as np
+
+from bioengine_tpu.rpc import schema_method
+
+N_STAGES = 2
+CHANNELS = 8
+
+
+def stage_params(stage):
+    rng = np.random.default_rng(100 + stage)
+    return {
+        "w": (rng.standard_normal((CHANNELS, CHANNELS)) * 0.2).astype(
+            np.float32
+        ),
+        "b": (rng.standard_normal((CHANNELS,)) * 0.1).astype(np.float32),
+    }
+
+
+class MeshDep:
+    """Two-stage channel-mixing model. A mesh shard holds ONLY its
+    stage (bioengine_mesh_shard injection); without one it builds the
+    full model (the single-host baseline)."""
+
+    async def async_init(self):
+        import jax.numpy as jnp
+
+        from bioengine_tpu.runtime.engine import (
+            InferenceEngine,
+            resolve_devices,
+        )
+
+        shard = getattr(self, "bioengine_mesh_shard", None)
+        lease = getattr(self, "bioengine_device_ids", None)
+        devices = resolve_devices(list(lease)) if lease else None
+        axes = dict(shard["axes"]) if shard else {"dp": -1}
+        stages = (
+            [int(shard["stage"])] if shard is not None else range(N_STAGES)
+        )
+        self.engines = {}
+        for k in stages:
+            last = k == N_STAGES - 1
+
+            def make_apply(last=last):
+                def apply_fn(params, x):
+                    y = x @ params["w"] + params["b"]
+                    return y if last else jnp.maximum(y, 0.0)
+
+                return apply_fn
+
+            self.engines[k] = InferenceEngine(
+                f"mesh-stage-{k}",
+                make_apply(),
+                stage_params(k),
+                devices=devices,
+                mesh_axes=axes,
+            )
+
+    @schema_method
+    async def run_stage(self, stage: int, inputs, context=None):
+        """One pipeline stage's forward on this shard's engine."""
+        engine = self.engines.get(int(stage))
+        if engine is None:
+            raise ValueError(
+                f"shard holds stages {sorted(self.engines)}, not {stage}"
+            )
+        return await engine.predict_async(np.asarray(inputs, np.float32))
+
+    @schema_method
+    async def predict(self, inputs, context=None):
+        """Full forward (single-host / parity baseline)."""
+        x = np.asarray(inputs, np.float32)
+        for k in sorted(self.engines):
+            x = await self.engines[k].predict_async(x)
+        return x
+
+    async def close(self):
+        for engine in self.engines.values():
+            engine.close()
+'''
+
+
+def reference_forward(x: np.ndarray) -> np.ndarray:
+    """Independent numpy forward of the same 2-stage model."""
+    rng0 = np.random.default_rng(100)
+    w0 = (rng0.standard_normal((CHANNELS, CHANNELS)) * 0.2).astype(np.float32)
+    b0 = (rng0.standard_normal((CHANNELS,)) * 0.1).astype(np.float32)
+    rng1 = np.random.default_rng(101)
+    w1 = (rng1.standard_normal((CHANNELS, CHANNELS)) * 0.2).astype(np.float32)
+    b1 = (rng1.standard_normal((CHANNELS,)) * 0.1).astype(np.float32)
+    h = np.maximum(x @ w0 + b0, 0.0)
+    return h @ w1 + b1
+
+
+def make_input(batch: int = 4) -> np.ndarray:
+    rng = np.random.default_rng(7)
+    # 4 * 16 * 16 * 8 * 4B = 32 KiB — comfortably above the 1 KiB OOB
+    # extraction threshold, so stage hops must show up in the codec's
+    # oob payload counters
+    return rng.standard_normal((batch, 16, 16, CHANNELS)).astype(np.float32)
+
+
+def _write_mesh_app(tmp_path: Path, manifest: str = MESH_MANIFEST) -> Path:
+    app_dir = tmp_path / "mesh-src"
+    app_dir.mkdir(exist_ok=True)
+    (app_dir / "manifest.yaml").write_text(manifest)
+    (app_dir / "mesh_dep.py").write_text(MESH_APP_SOURCE)
+    return app_dir
+
+
+def _no_local_chips() -> ClusterState:
+    return ClusterState(TpuTopology(chips=(), n_hosts=1, platform="cpu"))
+
+
+@pytest.fixture()
+async def mesh_plane(tmp_path):
+    server = RpcServer(host="127.0.0.1", admin_users=["admin"])
+    await server.start()
+    token = server.issue_token("admin", is_admin=True)
+    controller = ServeController(
+        _no_local_chips(), health_check_period=3600, breaker_threshold=2
+    )
+    controller.attach_rpc(server, admin_users=["admin"])
+    hosts = []
+
+    async def spawn_host(host_id: str, rejoin: bool = True) -> WorkerHost:
+        host = WorkerHost(
+            server_url=server.url,
+            token=token,
+            host_id=host_id,
+            workspace_dir=tmp_path / f"ws-{host_id}",
+            rejoin=rejoin,
+        )
+        await host.start()
+        hosts.append(host)
+        return host
+
+    try:
+        yield server, controller, spawn_host, tmp_path
+    finally:
+        for host in hosts:
+            try:
+                await host.stop()
+            except Exception:
+                pass
+        await controller.stop()
+        await server.stop()
+
+
+async def _kill_host(host: WorkerHost) -> None:
+    """In-process SIGKILL: sever the websocket with rejoin suppressed."""
+    host.rejoin = False
+    host.connection.auto_reconnect = False
+    host.connection._closing = True
+    await host.connection._abort_connection()
+
+
+async def _deploy_mesh_app(
+    controller, tmp_path, manifest: str = MESH_MANIFEST, app_id="mesh-app"
+):
+    builder = AppBuilder(workdir_root=tmp_path / "apps")
+    built = builder.build(
+        app_id=app_id, local_path=_write_mesh_app(tmp_path, manifest)
+    )
+    await controller.deploy(app_id, built.specs)
+    return controller.apps[app_id].replicas["mesh_dep"]
+
+
+# ---------------------------------------------------------------------------
+# config + planner units
+# ---------------------------------------------------------------------------
+
+
+class TestMeshConfig:
+    def test_from_config_defaults_and_values(self):
+        cfg = MeshConfig.from_config(
+            {
+                "stages": 3,
+                "chips_per_stage": 2,
+                "kind": "tp",
+                "axes": {"dp": -1, "tp": 2},
+                "entry_methods": ["predict", "embed"],
+                "stage_timeout_s": 12.5,
+            }
+        )
+        assert cfg.stages == 3
+        assert cfg.total_chips == 6
+        assert cfg.kind == "tp"
+        assert cfg.entry_methods == ("predict", "embed")
+        assert cfg.resolved_stage_timeout_s() == 12.5
+        assert cfg.mesh_shape() == {"pp": 3, "dp": 1, "tp": 2}
+
+    def test_unknown_keys_rejected(self):
+        with pytest.raises(ValueError, match="unknown mesh config keys"):
+            MeshConfig.from_config({"stagez": 2})
+
+    def test_bad_values_rejected(self):
+        with pytest.raises(ValueError, match="kind"):
+            MeshConfig.from_config({"kind": "ring"})
+        with pytest.raises(ValueError, match="stages"):
+            MeshConfig.from_config({"stages": 0})
+        with pytest.raises(ValueError, match="chips_per_stage"):
+            MeshConfig.from_config({"chips_per_stage": 0})
+        with pytest.raises(ValueError, match="entry_methods"):
+            MeshConfig.from_config({"entry_methods": []})
+
+    def test_axes_names_restricted_to_engine_axes(self):
+        # a typo like dpp must fail the BUILD, not every shard start
+        with pytest.raises(ValueError, match="unsupported axis"):
+            MeshConfig.from_config({"axes": {"dpp": -1}})
+        # negative widths other than the -1 fill survive Python modulo
+        # in MeshSpec.resolve and would clamp to an unsharded engine
+        with pytest.raises(ValueError, match="positive size"):
+            MeshConfig.from_config(
+                {"chips_per_stage": 4, "axes": {"dp": -1, "tp": -2}}
+            )
+
+    def test_builder_rejects_warm_pool_plus_mesh(self, tmp_path):
+        manifest = MESH_MANIFEST + "    warm_pool:\n      size: 1\n"
+        with pytest.raises(AppBuildError, match="warm_pool cannot combine"):
+            AppBuilder(workdir_root=tmp_path / "apps").build(
+                app_id="combo",
+                local_path=_write_mesh_app(tmp_path, manifest),
+            )
+
+    def test_axes_must_resolve_over_stage_lease(self):
+        # caught at BUILD time — an unresolvable axes spec must never
+        # reach shard-engine construction or a get_app_status call
+        with pytest.raises(ValueError, match="do not resolve"):
+            MeshConfig.from_config(
+                {"chips_per_stage": 4, "axes": {"tp": 3}}
+            )
+        # and a resolvable one still passes
+        cfg = MeshConfig.from_config(
+            {"chips_per_stage": 4, "axes": {"dp": -1, "tp": 2}}
+        )
+        assert cfg.mesh_shape() == {"pp": 2, "dp": 2, "tp": 2}
+
+    def test_builder_rejects_bad_mesh_block(self, tmp_path):
+        bad = MESH_MANIFEST.replace("kind: pipeline", "kind: moebius")
+        with pytest.raises(AppBuildError, match="mesh_dep"):
+            AppBuilder(workdir_root=tmp_path / "apps").build(
+                app_id="bad-mesh",
+                local_path=_write_mesh_app(tmp_path, bad),
+            )
+
+    def test_builder_parses_mesh_block(self, tmp_path):
+        built = AppBuilder(workdir_root=tmp_path / "apps").build(
+            app_id="ok-mesh", local_path=_write_mesh_app(tmp_path)
+        )
+        spec = built.specs[0]
+        assert spec.mesh is not None
+        assert spec.mesh.stages == 2
+        assert spec.mesh.chips_per_stage == 2
+
+
+class _FakeHost:
+    def __init__(self, host_id, n_chips, used=0):
+        self.host_id = host_id
+        self.service_id = f"svc-{host_id}"
+        self.n_chips = n_chips
+        self._used = used
+
+    def free_chip_ids(self):
+        return list(range(self._used, self.n_chips))
+
+
+class TestPlanner:
+    def test_capacity_forces_spanning(self):
+        hosts = [_FakeHost("h1", 2), _FakeHost("h2", 2)]
+        plan = plan_mesh(
+            MeshConfig(stages=2, chips_per_stage=2),
+            hosts,
+            HeuristicCostModel(),
+        )
+        assert plan.cross_host
+        assert plan.hosts == ["h1", "h2"]
+        assert [s.stage for s in plan.shards] == [0, 1]
+
+    def test_one_big_host_colocates_by_affinity(self):
+        # the warm-affinity bonus outweighs a 1/8 load bump, so the
+        # SAME spec collapses onto one big host when it fits — the
+        # topology-portability property
+        hosts = [_FakeHost("big", 8), _FakeHost("small", 2)]
+        plan = plan_mesh(
+            MeshConfig(stages=2, chips_per_stage=1),
+            hosts,
+            HeuristicCostModel(),
+        )
+        assert plan.hosts == ["big"]
+        assert not plan.cross_host
+
+    def test_avoided_host_steered_around(self):
+        hosts = [_FakeHost("h1", 4), _FakeHost("h2", 4)]
+        plan = plan_mesh(
+            MeshConfig(stages=2, chips_per_stage=2),
+            hosts,
+            HeuristicCostModel(),
+            avoid_hosts={"h1"},
+        )
+        assert plan.hosts == ["h2"]
+
+    def test_impossible_plan_raises_with_chip_bill(self):
+        with pytest.raises(MeshPlanError) as exc:
+            plan_mesh(
+                MeshConfig(stages=2, chips_per_stage=4),
+                [_FakeHost("h1", 2)],
+                HeuristicCostModel(),
+            )
+        assert exc.value.chips_needed == 8
+
+    def test_single_host_fallback_off_rejects_colocation(self):
+        with pytest.raises(MeshPlanError, match="single_host_fallback"):
+            plan_mesh(
+                MeshConfig(
+                    stages=2, chips_per_stage=1, single_host_fallback=False
+                ),
+                [_FakeHost("h1", 8)],
+                HeuristicCostModel(),
+            )
+
+    def test_fallback_off_spans_when_affinity_would_colocate(self):
+        # a big host where the affinity bonus outweighs the load bump
+        # would colocate both stages — with fallback forbidden the
+        # planner must retry affinity-free and SPAN (a valid spanning
+        # plan exists), not reject the deployment
+        hosts = [_FakeHost("big", 16), _FakeHost("small", 4)]
+        plan = plan_mesh(
+            MeshConfig(
+                stages=2, chips_per_stage=2, single_host_fallback=False
+            ),
+            hosts,
+            HeuristicCostModel(),
+        )
+        assert plan.cross_host
+        assert plan.hosts == ["big", "small"]
+        # …and WITH fallback allowed the same topology still colocates
+        plan2 = plan_mesh(
+            MeshConfig(stages=2, chips_per_stage=2),
+            hosts,
+            HeuristicCostModel(),
+        )
+        assert plan2.hosts == ["big"]
+
+    def test_fallback_off_spans_when_load_would_colocate(self):
+        # LOAD asymmetry (not affinity) pulls both stages onto the big
+        # idle host: A idle with 32 chips vs B at 50% occupancy — the
+        # spanning requirement must be a hard constraint, or the
+        # deployment stays down despite a feasible A+B plan
+        hosts = [_FakeHost("a", 32), _FakeHost("b", 8, used=4)]
+        plan = plan_mesh(
+            MeshConfig(
+                stages=2, chips_per_stage=2, single_host_fallback=False
+            ),
+            hosts,
+            HeuristicCostModel(),
+        )
+        assert plan.cross_host
+        assert plan.hosts == ["a", "b"]
+
+    def test_scorer_contract_is_the_scheduler_feature_dict(self):
+        seen: list[dict] = []
+
+        class Spy:
+            def score(self, features):
+                seen.append(features)
+                return 0.0
+
+        plan_mesh(
+            MeshConfig(stages=1, chips_per_stage=1),
+            [_FakeHost("h1", 2)],
+            Spy(),
+        )
+        assert set(seen[0]) == {
+            "load",
+            "queued",
+            "max_ongoing",
+            "breaker_failures",
+            "signature_affinity",
+            "avoided",
+            "group_size",
+        }
+
+
+# ---------------------------------------------------------------------------
+# the virtual-device layer in the engine
+# ---------------------------------------------------------------------------
+
+
+class TestEngineMeshAxes:
+    def _engine(self, devices, axes):
+        import jax
+
+        from bioengine_tpu.runtime.engine import InferenceEngine
+        from bioengine_tpu.runtime.program_cache import CompiledProgramCache
+
+        params = {"w": np.eye(4, dtype=np.float32)}
+        return InferenceEngine(
+            "mesh-axes-test",
+            lambda p, x: x @ p["w"],
+            params,
+            cache=CompiledProgramCache(),
+            devices=jax.devices()[: devices],
+            mesh_axes=axes,
+        )
+
+    def test_same_spec_resolves_per_width(self):
+        e1 = self._engine(1, {"dp": -1})
+        e4 = self._engine(4, {"dp": -1})
+        try:
+            assert e1.mesh_shape is None          # 1 chip = legacy path
+            assert e4.mesh_shape == {"dp": 4}
+        finally:
+            e1.close()
+            e4.close()
+
+    def test_unknown_axis_rejected(self):
+        with pytest.raises(ValueError, match="unsupported engine axes"):
+            self._engine(2, {"dp": -1, "pp": 2})
+
+    def test_non_dividing_spec_rejected(self):
+        with pytest.raises(ValueError):
+            self._engine(3, {"dp": -1, "tp": 2})
+
+
+# ---------------------------------------------------------------------------
+# CrossHostEngine composition (in-process stub shards)
+# ---------------------------------------------------------------------------
+
+
+class TestCrossHostEngine:
+    def _engine(self, kind, n, call_stage):
+        return CrossHostEngine(
+            MeshConfig(stages=n, chips_per_stage=1, kind=kind),
+            n,
+            call_stage,
+            app_id="t",
+            deployment="d",
+        )
+
+    async def test_pipeline_composes_in_order(self):
+        calls = []
+
+        async def stage(shard, method, args, timeout_s):
+            calls.append((shard, args[0]))
+            return np.asarray(args[1]) + 10 ** shard
+
+        eng = self._engine("pipeline", 3, stage)
+        out = await eng.run(np.zeros((2, 2), np.float32))
+        assert [c[0] for c in calls] == [0, 1, 2]
+        assert [c[1] for c in calls] == [0, 1, 2]  # stage index rides args
+        np.testing.assert_array_equal(out, np.full((2, 2), 111.0))
+        st = eng.stats()
+        assert st["stage_calls"] == 3
+        assert st["transfer_bytes"] > 0
+        assert st["transfer_seconds"] > 0
+
+    async def test_dp_splits_and_concats(self):
+        async def stage(shard, method, args, timeout_s):
+            return np.asarray(args[1]) * (shard + 1)
+
+        eng = self._engine("dp", 2, stage)
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        out = await eng.run(x)
+        np.testing.assert_array_equal(out[:4], x[:4] * 1)
+        np.testing.assert_array_equal(out[4:], x[4:] * 2)
+
+    async def test_dp_small_batch_skips_empty_shards(self):
+        calls = []
+
+        async def stage(shard, method, args, timeout_s):
+            calls.append((shard, len(np.asarray(args[1]))))
+            return np.asarray(args[1]) * 2
+
+        eng = self._engine("dp", 3, stage)
+        x = np.arange(2, dtype=np.float32).reshape(2, 1)
+        out = await eng.run(x)
+        np.testing.assert_array_equal(out, x * 2)
+        # batch 2 over 3 shards: no phantom empty hop to shard 2
+        assert calls == [(0, 1), (1, 1)]
+        assert eng.stats()["stage_calls"] == 2
+
+    async def test_tp_sums_partials(self):
+        async def stage(shard, method, args, timeout_s):
+            return np.asarray(args[1]) * (shard + 1)
+
+        eng = self._engine("tp", 3, stage)
+        x = np.ones((2, 2), np.float32)
+        out = await eng.run(x)
+        np.testing.assert_array_equal(out, x * 6)  # 1 + 2 + 3
+
+    async def test_exhausted_budget_fails_fast_between_hops(self):
+        from bioengine_tpu.serving.errors import DeadlineExceeded
+
+        calls = []
+
+        async def stage(shard, method, args, timeout_s):
+            calls.append(shard)
+            await asyncio.sleep(0.05)  # eats the whole composition budget
+            return np.asarray(args[1])
+
+        eng = self._engine("pipeline", 3, stage)
+        with pytest.raises(DeadlineExceeded):
+            await eng.run(np.zeros(4, np.float32), timeout_s=0.02)
+        # the doomed later hops never serialized onto the wire
+        assert calls == [0]
+
+    async def test_stage_timeout_budget_caps_hops(self):
+        budgets = []
+
+        async def stage(shard, method, args, timeout_s):
+            budgets.append(timeout_s)
+            return np.asarray(args[1])
+
+        cfg = MeshConfig(
+            stages=2, chips_per_stage=1, kind="pipeline", stage_timeout_s=0.5
+        )
+        eng = CrossHostEngine(cfg, 2, stage)
+        await eng.run(np.zeros(4, np.float32), timeout_s=10.0)
+        assert all(b is not None and b <= 0.5 for b in budgets)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: 2 in-process hosts, real websockets
+# ---------------------------------------------------------------------------
+
+
+class TestMeshServing:
+    async def test_two_host_pipeline_parity_and_oob(self, mesh_plane):
+        """Acceptance: a model sharded across 2 simulated hosts serves
+        requests through the normal handle path with output parity
+        pinned against the single-host forward (rtol=1e-4/atol=1e-5,
+        see module docstring), and the activation frames demonstrably
+        rode the zero-copy OOB path (RpcStats oob payload counters)."""
+        server, controller, spawn_host, tmp_path = mesh_plane
+        await spawn_host("h1")
+        await spawn_host("h2")
+        replicas = await _deploy_mesh_app(controller, tmp_path)
+        assert len(replicas) == 1
+        mesh = replicas[0]
+        assert isinstance(mesh, MeshReplica)
+        assert mesh.plan.cross_host
+        assert mesh.plan.hosts == ["h1", "h2"]
+        # 2 chips leased per stage, each under the MESH replica's id
+        for host_id in ("h1", "h2"):
+            rec = controller.cluster_state.hosts[host_id]
+            assert list(rec.chips_in_use.values()) == [mesh.replica_id] * 2
+
+        before = server.stats.as_dict()
+        x = make_input()
+        handle = controller.get_handle("mesh-app", "mesh_dep")
+        out = np.asarray(await handle.call("predict", x))
+        np.testing.assert_allclose(
+            out, reference_forward(x), rtol=1e-4, atol=1e-5
+        )
+
+        # the stage activations crossed hosts as extracted OOB payloads
+        # (shm_puts would be the same-host store path; these arrays sit
+        # under the 1 MiB shm threshold so they must land on the wire
+        # table) — pinned, not assumed
+        after = server.stats.as_dict()
+        assert (
+            after["oob_payloads_out"] > before["oob_payloads_out"]
+        ), after
+        assert after["legacy_msgs_out"] == before["legacy_msgs_out"]
+        st = mesh.engine.stats()
+        assert st["stage_calls"] == N_STAGES
+        assert st["transfer_bytes"] >= 2 * x.nbytes
+
+    async def test_same_spec_runs_on_one_host(self, mesh_plane):
+        """Topology portability: the SAME deployment spec, one joined
+        host — both stages colocate there and outputs match the same
+        reference. No manifest/app change."""
+        server, controller, spawn_host, tmp_path = mesh_plane
+        await spawn_host("solo")
+        replicas = await _deploy_mesh_app(controller, tmp_path)
+        mesh = replicas[0]
+        assert not mesh.plan.cross_host
+        assert mesh.plan.hosts == ["solo"]
+        rec = controller.cluster_state.hosts["solo"]
+        assert list(rec.chips_in_use.values()) == [mesh.replica_id] * 4
+        x = make_input()
+        handle = controller.get_handle("mesh-app", "mesh_dep")
+        out = np.asarray(await handle.call("predict", x))
+        np.testing.assert_allclose(
+            out, reference_forward(x), rtol=1e-4, atol=1e-5
+        )
+
+    async def test_serves_through_global_scheduler(self, mesh_plane):
+        """The PR 8 scheduler treats the mesh as a normal replica:
+        coalesced groups dispatch through MeshReplica.call_batch and
+        every member's output stays correct."""
+        server, controller, spawn_host, tmp_path = mesh_plane
+        await spawn_host("h1")
+        await spawn_host("h2")
+        await _deploy_mesh_app(
+            controller, tmp_path, manifest=SCHEDULED_MESH_MANIFEST
+        )
+        scheduler = controller._schedulers[("mesh-app", "mesh_dep")]
+        handle = controller.get_handle("mesh-app", "mesh_dep")
+        xs = [make_input(batch=2) + i for i in range(6)]
+        outs = await asyncio.gather(
+            *(handle.call("predict", x) for x in xs)
+        )
+        for x, out in zip(xs, outs):
+            np.testing.assert_allclose(
+                np.asarray(out), reference_forward(x), rtol=1e-4, atol=1e-5
+            )
+        stats = scheduler.describe()["stats"]
+        assert stats["dispatched_groups"] + stats["fast_path"] >= 1
+
+    async def test_status_shows_one_logical_deployment(self, mesh_plane):
+        server, controller, spawn_host, tmp_path = mesh_plane
+        await spawn_host("h1")
+        await spawn_host("h2")
+        replicas = await _deploy_mesh_app(controller, tmp_path)
+        handle = controller.get_handle("mesh-app", "mesh_dep")
+        await handle.call("predict", make_input())
+        status = controller.get_app_status("mesh-app")
+        dep = status["deployments"]["mesh_dep"]
+        assert dep["num_replicas"] == 1
+        rid = replicas[0].replica_id
+        mesh = dep["cross_host_mesh"][rid]
+        assert mesh["kind"] == "pipeline"
+        assert mesh["cross_host"] is True
+        assert mesh["hosts"] == ["h1", "h2"]
+        assert [s["host_id"] for s in mesh["shards"]] == ["h1", "h2"]
+        assert all(len(s["device_ids"]) == 2 for s in mesh["shards"])
+        assert mesh["transfer"]["stage_calls"] >= N_STAGES
+        assert mesh["transfer"]["transfer_bytes"] > 0
+        assert mesh["transfer"]["transfer_bytes_per_sec"] is not None
+        assert dep["mesh_shapes"][rid] == {"pp": 2, "dp": 2}
+        # the CLI renders this rollup
+        from bioengine_tpu.cli.apps import _mesh_lines
+
+        lines = _mesh_lines(status)
+        assert len(lines) == 1
+        assert "pipeline mesh" in lines[0] and "cross-host" in lines[0]
+
+    async def test_profile_replica_covers_every_shard_host(self, mesh_plane):
+        """profile_replica on a mesh replica routes to EVERY shard host
+        (jax.profiler is per-process; a mesh spans several) instead of
+        reading the single-host host_service_id a mesh doesn't have."""
+        from types import SimpleNamespace
+
+        from bioengine_tpu.utils.permissions import create_context
+        from bioengine_tpu.worker.worker import BioEngineWorker
+
+        server, controller, spawn_host, tmp_path = mesh_plane
+        await spawn_host("h1")
+        await spawn_host("h2")
+        await _deploy_mesh_app(controller, tmp_path)
+        stub = SimpleNamespace(admin_users=["admin"], controller=controller)
+        result = await BioEngineWorker.profile_replica(
+            stub, "mesh-app", action="memory", context=create_context("admin")
+        )
+        assert set(result["hosts"]) == {"h1", "h2"}
+        for host_id, snap in result["hosts"].items():
+            assert snap["host_id"] == host_id
+        # one shard host unreachable mid-incident: the live host's data
+        # still comes back, the dead one reports its error
+        svc = controller.cluster_state.hosts["h2"].service_id
+        server.unregister_service(svc)
+        partial = await BioEngineWorker.profile_replica(
+            stub, "mesh-app", action="memory", context=create_context("admin")
+        )
+        assert partial["hosts"]["h1"]["host_id"] == "h1"
+        assert "error" in partial["hosts"]["h2"]
+
+    async def test_mesh1_gating_excludes_legacy_hosts(self, mesh_plane):
+        """A host whose connection never declared mesh1 is invisible to
+        the planner: deploy fails typed and enqueues the WHOLE mesh's
+        chip bill as pending work."""
+        server, controller, spawn_host, tmp_path = mesh_plane
+        host = await spawn_host("old")
+        # simulate a legacy host: strip mesh1 from what its ws declared
+        svc = controller.cluster_state.hosts["old"].service_id
+        entry = server._services[svc]
+        server._client_protos[entry.owner_client] = frozenset(
+            {"oob1", "trace1", "telem1"}
+        )
+        assert not server.service_peer_supports(svc, "mesh1")
+        builder = AppBuilder(workdir_root=tmp_path / "apps")
+        built = builder.build(
+            app_id="mesh-app", local_path=_write_mesh_app(tmp_path)
+        )
+        with pytest.raises(MeshPlanError):
+            await controller.deploy("mesh-app", built.specs)
+        pending = controller.cluster_state.pending()
+        assert any(
+            p.workload_id == "mesh-app/mesh_dep"
+            and p.resources["chips"] == 4
+            for p in pending
+        )
+
+    async def test_host_refuses_mesh_shard_without_mesh1(self, mesh_plane):
+        """The host-side half of the capability gate: a controller that
+        never advertised mesh1 must not get a partial model served as
+        if it were whole."""
+        server, controller, spawn_host, tmp_path = mesh_plane
+        host = await spawn_host("hg")
+        host.connection.peer_protocols = [
+            p for p in host.connection.peer_protocols if p != "mesh1"
+        ]
+        with pytest.raises(RuntimeError, match="mesh1"):
+            await host.start_replica(
+                "r-1", {"app_id": "x", "deployment": "d", "files": {}},
+                mesh_shard={"stage": 0, "n_stages": 2, "kind": "pipeline"},
+            )
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill a shard host mid-traffic
+# ---------------------------------------------------------------------------
+
+
+class TestMeshChaos:
+    async def test_shard_host_death_fails_over_to_fallback_mesh(
+        self, mesh_plane
+    ):
+        """Satellite acceptance: kill one shard host mid-traffic —
+        idempotent requests fail over typed into the re-planned
+        single-host fallback mesh, chip accounting stays exact, and no
+        lease leaks. Flight order pins establish < degrade <
+        (fallback) establish."""
+        server, controller, spawn_host, tmp_path = mesh_plane
+        h1 = await spawn_host("h1")
+        h2 = await spawn_host("h2")
+        replicas = await _deploy_mesh_app(controller, tmp_path)
+        first_mesh = replicas[0]
+        assert first_mesh.plan.cross_host
+        handle = controller.get_handle("mesh-app", "mesh_dep")
+        opts = RequestOptions(idempotent=True, deadline_s=30, max_attempts=10)
+        x = make_input(batch=2)
+        expected = reference_forward(x)
+
+        failures: list[Exception] = []
+        successes = [0]
+        killed = asyncio.Event()
+        stop_ticking = asyncio.Event()
+
+        async def ticker():
+            # the health loop, compressed: detect the dead host, stop
+            # the degraded mesh, re-plan onto the survivor
+            while not stop_ticking.is_set():
+                await controller.health_tick()
+                await asyncio.sleep(0.1)
+
+        async def traffic(worker_id: int):
+            for i in range(12):
+                try:
+                    out = await handle.call("predict", x, options=opts)
+                    np.testing.assert_allclose(
+                        np.asarray(out), expected, rtol=1e-4, atol=1e-5
+                    )
+                    successes[0] += 1
+                except Exception as e:  # noqa: BLE001 — counted, not raised
+                    failures.append(e)
+                if worker_id == 0 and i == 3:
+                    killed.set()
+                await asyncio.sleep(0.01)
+
+        tick_task = asyncio.create_task(ticker())
+        traffic_tasks = [
+            asyncio.create_task(traffic(w)) for w in range(3)
+        ]
+        await killed.wait()
+        await _kill_host(h2)
+        await asyncio.gather(*traffic_tasks)
+        stop_ticking.set()
+        await tick_task
+
+        assert failures == [], [str(f)[:200] for f in failures]
+        assert successes[0] == 36
+
+        # fallback mesh: re-planned entirely onto the survivor
+        new = controller.apps["mesh-app"].replicas["mesh_dep"]
+        assert len(new) == 1
+        fallback = new[0]
+        assert fallback.replica_id != first_mesh.replica_id
+        assert fallback.plan.hosts == ["h1"]
+        assert not fallback.plan.cross_host
+
+        # chip accounting exact: survivor carries exactly the fallback
+        # mesh's 4 chips, the dead host's ledger is empty, nothing
+        # still references the first mesh
+        h1_rec = controller.cluster_state.hosts["h1"]
+        h2_rec = controller.cluster_state.hosts["h2"]
+        assert sorted(h1_rec.chips_in_use.values()) == (
+            [fallback.replica_id] * 4
+        )
+        assert h2_rec.chips_in_use == {}
+        assert not h2_rec.alive
+
+        # flight evidence, in order
+        events = flight.get_record(limit=2000)["events"]
+        def seq(etype, **match):
+            return [
+                e["seq"]
+                for e in events
+                if e["type"] == etype
+                and all(e["attrs"].get(k) == v for k, v in match.items())
+            ]
+        est_first = seq("mesh.establish", replica=first_mesh.replica_id)
+        degrade = seq("mesh.degrade", replica=first_mesh.replica_id)
+        est_fallback = seq("mesh.establish", replica=fallback.replica_id)
+        assert est_first and degrade and est_fallback
+        assert est_first[0] < degrade[0] < est_fallback[0]
+
+    async def test_replan_steers_around_alive_but_faulty_host(
+        self, mesh_plane
+    ):
+        """A shard failing on a host that stays CONNECTED (bad device,
+        wedged process — not a websocket death) must not get the
+        replacement mesh planned straight back onto it: the restart
+        path passes the mesh's degraded_hosts into plan_mesh, where the
+        `avoided` feature scores the host last-resort."""
+        server, controller, spawn_host, tmp_path = mesh_plane
+        h1 = await spawn_host("h1")
+        h2 = await spawn_host("h2")
+        replicas = await _deploy_mesh_app(controller, tmp_path)
+        first = replicas[0]
+        assert first.plan.cross_host
+        # wedge the h2 shard without killing the host: drop the shard
+        # replica out of the host process so its health check fails
+        h2_shard = next(
+            s for s in first.plan.shards if s.host_id == "h2"
+        )
+        await h2.stop_replica(first.shard_replica_id(h2_shard.stage))
+        assert await first.check_health() == ReplicaState.UNHEALTHY
+        assert first.degraded_hosts == {"h2"}
+        await controller.health_tick()
+        new = controller.apps["mesh-app"].replicas["mesh_dep"][0]
+        assert new.replica_id != first.replica_id
+        # h2 is alive with MORE free chips than h1 — only the avoid
+        # steering keeps the replacement off it
+        assert controller.cluster_state.hosts["h2"].alive
+        assert new.plan.hosts == ["h1"]
+
+    async def test_drained_shard_fails_mesh_health(self, mesh_plane):
+        """A shard parked DRAINING host-side (admin drain, not a death)
+        serves nothing — the mesh must go UNHEALTHY so the health loop
+        re-plans it, not stay routable around a dead stage."""
+        server, controller, spawn_host, tmp_path = mesh_plane
+        await spawn_host("h1")
+        h2 = await spawn_host("h2")
+        replicas = await _deploy_mesh_app(controller, tmp_path)
+        mesh = replicas[0]
+        h2_shard = next(s for s in mesh.plan.shards if s.host_id == "h2")
+        shard_rid = mesh.shard_replica_id(h2_shard.stage)
+        await h2.drain_replica(shard_rid)
+        assert h2.replicas[shard_rid].state == ReplicaState.DRAINING
+        assert await mesh.check_health() == ReplicaState.UNHEALTHY
+        assert "h2" in mesh.degraded_hosts
+
+    async def test_undeploy_tears_down_and_releases_everything(
+        self, mesh_plane
+    ):
+        server, controller, spawn_host, tmp_path = mesh_plane
+        await spawn_host("h1")
+        await spawn_host("h2")
+        replicas = await _deploy_mesh_app(controller, tmp_path)
+        rid = replicas[0].replica_id
+        handle = controller.get_handle("mesh-app", "mesh_dep")
+        await handle.call("predict", make_input())
+        await controller.undeploy("mesh-app")
+        for host_id in ("h1", "h2"):
+            assert controller.cluster_state.hosts[host_id].chips_in_use == {}
+        events = flight.get_record(limit=2000)["events"]
+        teardown = [
+            e
+            for e in events
+            if e["type"] == "mesh.teardown"
+            and e["attrs"].get("replica") == rid
+        ]
+        assert teardown
+        assert teardown[0]["attrs"]["stage_calls"] >= N_STAGES
