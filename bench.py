@@ -96,6 +96,7 @@ STAGE_COSTS = {
     "search": 40,
     "observability_overhead": 25,
     "scheduler_goodput": 25,
+    "gray_failure": 20,
     "flash": 55,
     "unet3d": 70,
     "ivfpq": 70,   # measured 46 s standalone (train 20 + encode 22)
@@ -2037,6 +2038,100 @@ def _bench_scheduler(cpu: bool) -> dict:  # noqa: ARG001 — pure host path
     return asyncio.run(run())
 
 
+def _bench_gray_failure(cpu: bool) -> dict:  # noqa: ARG001 — pure host path
+    """Gray-failure defense proof on the scenario engine's acceptance
+    scenario: the SAME seeded slow-ramp incident (one replica degrades
+    to ~30x service time while still passing health checks) run twice —
+    without and with probation + hedging. Reports per leg: goodput,
+    p50/p99, the healthy-baseline vs post-incident-tail p99 split, and
+    the invariant verdicts. The defended leg's tail p99 must recover
+    toward the healthy baseline (the p99_recovery invariant, <= 2x);
+    the undefended leg must SHOW the degradation — both directions are
+    the ok gate, so a scenario that stops exercising the failure fails
+    the stage as loudly as a defense that stops working."""
+    import asyncio
+    import dataclasses
+
+    from bioengine_tpu.testing.scenarios import (
+        SLOW_REPLICA,
+        run_scenario_async,
+    )
+
+    seed = int(os.environ.get("BENCH_GRAY_SEED", "7"))
+    # 1 chip/replica: the bench worker's jax is already initialized
+    # (single CPU device), and this scenario never re-places a replica
+    # — the accounting invariant still runs, just on smaller leases
+    scenario = dataclasses.replace(SLOW_REPLICA, chips_per_replica=1)
+
+    async def run():
+        undefended = await run_scenario_async(
+            scenario, seed=seed, defenses=False
+        )
+        defended = await run_scenario_async(
+            scenario, seed=seed, defenses=True
+        )
+        return undefended, defended
+
+    undefended, defended = asyncio.run(run())
+
+    def leg(r: dict) -> dict:
+        ok = r["counts"].get("ok", 0)
+        return {
+            "requests": r["requests"],
+            "failed": r["requests"] - ok,
+            "wall_s": r["wall_s"],
+            "goodput_rps": round(ok / max(r["wall_s"], 1e-9), 1),
+            "p50_ms": r["latency_ms"]["p50"],
+            "p99_ms": r["latency_ms"]["p99"],
+            "baseline_p99_ms": r["phases"]["baseline_p99_ms"],
+            "tail_p99_ms": r["phases"]["tail_p99_ms"],
+            "probations": r["probations"],
+            "hedges": r["hedges"],
+            "invariants_ok": r["passed"],
+        }
+
+    legs = {"undefended": leg(undefended), "defended": leg(defended)}
+    recovered = defended["invariants"]["p99_recovery"]["ok"]
+    degraded = not undefended["invariants"]["p99_recovery"]["ok"]
+    out = {
+        "scenario": scenario.name,
+        "seed": seed,
+        "legs": legs,
+        "tail_p99_improvement": round(
+            legs["undefended"]["tail_p99_ms"]
+            / max(legs["defended"]["tail_p99_ms"], 1e-9),
+            2,
+        ),
+        "goodput_delta_pct": round(
+            100.0
+            * (
+                legs["defended"]["goodput_rps"]
+                - legs["undefended"]["goodput_rps"]
+            )
+            / max(legs["undefended"]["goodput_rps"], 1e-9),
+            2,
+        ),
+        "p99_recovered": recovered,
+        "degradation_shown": degraded,
+        "ok": (
+            defended["passed"]
+            and recovered
+            and degraded
+            and legs["defended"]["failed"] == 0
+            and legs["undefended"]["failed"] == 0
+        ),
+        "note": (
+            "same seeded slow-ramp incident both legs (scenario "
+            "engine, in-process multi-host harness). undefended = "
+            "failover/breaker only (PR 4); defended = latency-outlier "
+            "probation + p95-delay request hedging. tail_p99 is the "
+            "post-incident window; the defended leg must sit within "
+            "2x the healthy baseline, the undefended leg must not."
+        ),
+    }
+    return out
+
+
 def worker_main() -> int:
     cpu = os.environ.get("BENCH_PLATFORM", "").lower() == "cpu"
     if cpu:
@@ -2101,6 +2196,7 @@ def worker_main() -> int:
         "search": _bench_search,
         "observability_overhead": _bench_observability,
         "scheduler_goodput": _bench_scheduler,
+        "gray_failure": _bench_gray_failure,
         "flash": _bench_flash,
         "ivfpq": _bench_ivfpq,
         "pqflat": _bench_pqflat,
@@ -2423,6 +2519,7 @@ def _final_json(shared: _Shared, deadline_hit: bool) -> str:
                 "observability_overhead"
             ),
             "scheduler_goodput": shared.stages.get("scheduler_goodput"),
+            "gray_failure": shared.stages.get("gray_failure"),
             "cellpose_finetune": shared.stages.get("cellpose"),
             "attempts": shared.attempts,
         }

@@ -1,0 +1,140 @@
+"""``bioengine scenarios`` — run replayable synthetic incidents.
+
+The scenario engine (bioengine_tpu/testing/scenarios.py) turns the
+failure modes production will eventually throw — slow-but-alive
+replicas, preemption storms, tenant floods, diurnal waves, connection
+blip storms — into seeded, time-compressed, DETERMINISTIC runs against
+the in-process multi-host harness, each checked against declarative
+invariants. ``run`` executes one (optionally twice, diffing the
+outcome sequences — the determinism gate), ``list`` shows the catalog.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import click
+
+from bioengine_tpu.cli.utils import emit
+
+
+def _prepare_cpu_devices() -> None:
+    """Scenarios need a few virtual chips per in-process host. On a
+    CPU backend, force the same 8-device layout the test suite uses —
+    but only while jax is still unimported (the flag is read at
+    backend init) and only when no accelerator is expected."""
+    if "jax" in sys.modules:
+        return
+    if os.environ.get("JAX_PLATFORMS", "").lower() not in ("", "cpu"):
+        return
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+@click.group("scenarios")
+def scenarios_group() -> None:
+    """Deterministic synthetic incidents (scenario engine)."""
+
+
+@scenarios_group.command("list")
+def scenarios_list_command() -> None:
+    """The named-scenario catalog: what each one injects and checks."""
+    from bioengine_tpu.testing.scenarios import list_scenarios
+
+    rows = list_scenarios()
+    lines = []
+    for s in rows:
+        topo = (
+            f"{s['hosts']}h/{s['replicas']}r"
+            if s["hosts"]
+            else f"local/{s['replicas']}r"
+        )
+        sched = " sched" if s["scheduled"] else ""
+        lines.append(f"{s['name']:<18} {topo:>9}{sched:<6} {s['description']}")
+        if s["faults"]:
+            lines.append(
+                "                   faults: "
+                + ", ".join(
+                    f"t{f['tick']}:{f['action']}"
+                    + (f"@{f['host']}" if f["host"] else "")
+                    for f in s["faults"]
+                )
+            )
+    emit(rows, human="\n".join(lines))
+
+
+@scenarios_group.command("run")
+@click.argument("name")
+@click.option("--seed", default=0, show_default=True, help="Workload seed")
+@click.option(
+    "--no-defenses",
+    is_flag=True,
+    help="Disable probation + hedging (show the undefended degradation)",
+)
+@click.option(
+    "--check-determinism",
+    is_flag=True,
+    help="Run twice with the same seed and diff the outcome sequences",
+)
+@click.option(
+    "--out", default=None, help="Write the full result artifact as JSON"
+)
+def scenarios_run_command(name, seed, no_defenses, check_determinism, out):
+    """Run one named scenario and enforce its invariants (non-zero exit
+    on any required-invariant failure or a determinism mismatch)."""
+    _prepare_cpu_devices()
+    import logging
+
+    # replica/controller lifecycle chatter would drown the verdict
+    logging.disable(logging.WARNING)
+    from bioengine_tpu.testing.scenarios import (
+        get_scenario,
+        outcome_signature,
+        run_scenario,
+    )
+
+    scenario = get_scenario(name)
+    defenses = not no_defenses
+    result = run_scenario(scenario, seed=seed, defenses=defenses)
+    runs = [result]
+    deterministic = None
+    if check_determinism:
+        second = run_scenario(scenario, seed=seed, defenses=defenses)
+        runs.append(second)
+        deterministic = outcome_signature(result) == outcome_signature(second)
+
+    lines = [
+        f"scenario {name} seed={seed} defenses={defenses}: "
+        f"{'PASS' if result['passed'] else 'FAIL'} "
+        f"({result['requests']} requests, {result['counts']})",
+        f"  latency p50/p95/p99 ms: "
+        f"{result['latency_ms']['p50']}/{result['latency_ms']['p95']}"
+        f"/{result['latency_ms']['p99']}  "
+        f"probations={result['probations']} hedges={result['hedges']}",
+    ]
+    for iname, v in result["invariants"].items():
+        mark = "ok " if v["ok"] else "FAIL"
+        req = "" if v["required"] else " (informational)"
+        lines.append(f"  [{mark}] {iname}{req}: {v['detail']}")
+    if deterministic is not None:
+        lines.append(
+            f"  determinism: {'identical' if deterministic else 'DIVERGED'}"
+        )
+
+    artifact = {
+        "result": {k: v for k, v in result.items() if k != "outcomes"},
+        "deterministic": deterministic,
+    }
+    if out:
+        with open(out, "w") as f:
+            json.dump({**artifact, "runs": runs}, f, indent=2, default=str)
+        lines.append(f"  artifact: {out}")
+    emit(artifact, human="\n".join(lines))
+    if not result["passed"] or deterministic is False:
+        raise SystemExit(1)

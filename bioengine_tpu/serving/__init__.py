@@ -20,6 +20,10 @@ from bioengine_tpu.serving.mesh_plan import (
     plan_mesh,
 )
 from bioengine_tpu.serving.mesh_replica import CrossHostEngine, MeshReplica
+from bioengine_tpu.serving.outlier import (
+    DeploymentLatencyTracker,
+    OutlierConfig,
+)
 from bioengine_tpu.serving.replica import Replica, ReplicaState
 from bioengine_tpu.serving.scheduler import (
     DeploymentScheduler,
@@ -38,6 +42,7 @@ __all__ = [
     "CrossHostEngine",
     "DeadlineExceeded",
     "DeploymentHandle",
+    "DeploymentLatencyTracker",
     "DeploymentScheduler",
     "DeploymentSpec",
     "HeuristicCostModel",
@@ -48,6 +53,7 @@ __all__ = [
     "MeshReplica",
     "plan_mesh",
     "NoHealthyReplicasError",
+    "OutlierConfig",
     "Replica",
     "ReplicaState",
     "ReplicaUnavailableError",

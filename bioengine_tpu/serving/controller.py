@@ -36,11 +36,18 @@ from bioengine_tpu.serving.errors import (
     RetryableTransportError,
     classify_exception,
     is_caller_timeout,
+    is_retryable,
 )
 from bioengine_tpu.serving.mesh_plan import (
     MeshConfig,
     MeshPlanError,
     plan_mesh,
+)
+from bioengine_tpu.serving.outlier import (
+    DeploymentLatencyTracker,
+    OutlierConfig,
+    REPLICA_PROBATIONS,
+    record_probation_event,
 )
 from bioengine_tpu.serving.mesh_replica import MeshReplica
 from bioengine_tpu.serving.remote import RemoteReplica
@@ -95,6 +102,11 @@ BREAKER_TRIPS = metrics.counter(
     "breaker_trips_total",
     "circuit-breaker ejections (replica marked UNHEALTHY)",
     ("app", "deployment"),
+)
+REQUEST_HEDGES = metrics.counter(
+    "request_hedges_total",
+    "hedge attempts launched for idempotent calls, by winning attempt",
+    ("app", "deployment", "winner"),
 )
 
 
@@ -178,7 +190,21 @@ class RequestOptions:
     global scheduler attached: the priority class picks the
     weighted-fair queue (``interactive`` / ``bulk`` / ``background`` by
     default) and the tenant id counts against the per-tenant admission
-    quota."""
+    quota.
+
+    ``hedge`` opts an **idempotent** call into request hedging (the
+    gray-failure tail defense): when the first attempt is still
+    running after a p95-derived delay (override: ``hedge_delay_s``), a
+    second attempt launches on a DIFFERENT replica; the first result
+    wins and the loser is cancelled — never counted against the
+    breaker or the latency outlier detector (a loser cancelled by the
+    winner is not replica-failure evidence). Hedging a non-idempotent
+    call would double side effects, so that combination is rejected at
+    construction — hedges can never fire for non-idempotent calls.
+    Hedging applies to ROUTER-path deployments only: on a deployment
+    with a ``scheduling:`` config the global scheduler owns placement
+    (probation rides its scorer feature dict instead) and ``hedge`` is
+    ignored."""
 
     timeout_s: Optional[float] = None
     deadline_s: Optional[float] = None
@@ -188,6 +214,16 @@ class RequestOptions:
     backoff_cap_s: float = 2.0
     priority: Optional[str] = None     # scheduler class; None = default
     tenant: Optional[str] = None       # admission quota bucket
+    hedge: bool = False                # idempotent-only tail hedging
+    hedge_delay_s: Optional[float] = None  # None = deployment p95
+
+    def __post_init__(self):
+        if self.hedge and not self.idempotent:
+            raise ValueError(
+                "RequestOptions(hedge=True) requires idempotent=True — "
+                "a hedge is a silent second execution, which a "
+                "non-idempotent call can never tolerate"
+            )
 
     @classmethod
     def from_env(cls) -> "RequestOptions":
@@ -482,15 +518,37 @@ class DeploymentHandle:
                         )
             budget = _min_defined(options.timeout_s, remaining)
             self._controller._queue_depth[key] += 1
+            # hedged attempts do their own breaker/latency bookkeeping
+            # per sub-attempt (a cancelled loser must feed NEITHER) —
+            # the outer handlers skip theirs to avoid double counting
+            hedged = (
+                scheduler is None
+                and replica is not None
+                and options.hedge
+                and options.idempotent
+            )
             try:
+                if hedged:
+                    result = await self._hedged_attempt(
+                        replica, method, args, kwargs, options,
+                        budget, deadline, tried, attempt,
+                    )
+                    return result
                 with tracing.trace_span(
                     "attempt",
                     replica=replica.replica_id if replica else "scheduler",
                     attempt=attempt,
                 ):
                     if scheduler is None:
+                        t_attempt = time.monotonic()
                         result = await replica.call_bounded(
                             method, args, kwargs, timeout_s=budget
+                        )
+                        # successful-attempt service time feeds the
+                        # gray-failure outlier EWMA (failures measure
+                        # the transport, not the replica)
+                        self._controller._note_attempt_latency(
+                            replica, time.monotonic() - t_attempt
                         )
                     else:
                         # the scheduler owns admission, fair queueing,
@@ -517,7 +575,11 @@ class DeploymentHandle:
                 # a timeout of the CALLER's own budget says nothing
                 # about replica health — only genuine transport/placement
                 # failures feed the circuit breaker
-                if replica is not None and not is_caller_timeout(e):
+                if (
+                    replica is not None
+                    and not hedged
+                    and not is_caller_timeout(e)
+                ):
                     self._controller._breaker_failure(replica, e)
                 # scheduler-dispatched failures stamp the serving
                 # replica on the exception so failover can avoid it
@@ -594,6 +656,233 @@ class DeploymentHandle:
                     ):
                         depth.pop(key, None)
 
+    # ---- request hedging (gray-failure tail defense) ------------------------
+
+    async def _hedged_attempt(
+        self,
+        primary,
+        method: str,
+        args: tuple,
+        kwargs: dict,
+        options: RequestOptions,
+        budget: Optional[float],
+        deadline: Optional[float],
+        tried: set,
+        attempt: int,
+    ) -> Any:
+        """One attempt with tail hedging: run on ``primary``; if it is
+        still in flight after the p95-derived delay, launch the SAME
+        call on a different replica — first result wins, the loser is
+        cancelled. Only reachable for idempotent calls (RequestOptions
+        enforces that at construction; the router re-checks).
+
+        Bookkeeping discipline — the satellite bug this pins: the
+        cancelled loser feeds NEITHER the circuit breaker NOR the
+        outlier EWMA (a loser cancelled by the winner is not replica-
+        failure evidence, the same class of bug as the caller-budget
+        breaker exemption). Only genuinely-failed sub-attempts strike
+        the breaker; only the winner's wall time feeds the EWMA. Both
+        sub-attempts open sibling ``attempt`` spans under the one
+        trace_id, so `get_traces` shows the hedge as two children of
+        the same request."""
+        controller = self._controller
+
+        async def run(target, label: str, timeout_s: Optional[float]):
+            t0 = time.monotonic()
+            # span opened INSIDE the task: each sub-attempt becomes its
+            # own sibling under the request/route span (create_task
+            # copies the context, so both inherit the same parent)
+            with tracing.trace_span(
+                "attempt",
+                replica=target.replica_id,
+                attempt=attempt,
+                hedge=label,
+            ):
+                result = await target.call_bounded(
+                    method, args, kwargs, timeout_s=timeout_s
+                )
+            return result, time.monotonic() - t0
+
+        # a probe-routed request (primary in PROBATION) is the trickle
+        # the recovery loop lives on: it hedges AT ONCE (delay 0 — the
+        # probe exists to measure the replica, not to make one unlucky
+        # caller pay the gray-latency tax), and on any exit the probe
+        # attempt is DETACHED to finish in the background instead of
+        # cancelled — cancelling it would throw away the one latency
+        # measurement the probe exists to take, freezing the replica
+        # in probation forever once every caller hedges. Bounded by
+        # the attempt's own timeout budget; chip/semaphore accounting
+        # settles on its normal completion path.
+        probing = primary.state == ReplicaState.PROBATION
+        t_primary = asyncio.create_task(run(primary, "primary", budget))
+        t_hedge: Optional[asyncio.Task] = None
+        detached: set = set()
+
+        async def resolve_primary_only() -> Any:
+            try:
+                result, dt = await t_primary
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                # same breaker discipline as the scheduler paths: only
+                # TRANSPORT-classified failures are replica-health
+                # evidence — an app error (bad client input) or the
+                # caller's own budget expiring must never eject a
+                # healthy replica
+                if not is_caller_timeout(exc) and is_retryable(exc):
+                    controller._breaker_failure(primary, exc)
+                raise
+            controller._note_attempt_latency(primary, dt)
+            controller._breaker_success(primary)
+            return result
+
+        # ONE try/finally owns both attempt tasks for the whole hedged
+        # call: a caller cancellation anywhere in here (wait_for around
+        # handle.call, client disconnect) must cancel the in-flight
+        # attempts too — cancelling the awaiter never cancels a Task
+        try:
+            delay = (
+                0.0
+                if probing
+                else controller.hedge_delay_s(
+                    self.app_id, self.deployment, options
+                )
+            )
+            done, _ = await asyncio.wait({t_primary}, timeout=delay)
+            if done:
+                # resolved inside the hedge window — no hedge needed;
+                # this path costs one asyncio.wait over a direct await
+                return await resolve_primary_only()
+            try:
+                hedge_replica = controller._pick_replica(
+                    self.app_id,
+                    self.deployment,
+                    avoid=set(tried) | {primary.replica_id},
+                )
+            except (NoHealthyReplicasError, KeyError):
+                hedge_replica = None
+            hedge_budget = budget
+            if deadline is not None:
+                hedge_budget = _min_defined(
+                    options.timeout_s, deadline - time.monotonic()
+                )
+                if hedge_budget is not None and hedge_budget <= 0:
+                    hedge_replica = None
+            if (
+                hedge_replica is None
+                or hedge_replica.replica_id == primary.replica_id
+            ):
+                # nobody distinct to hedge on (single-replica
+                # deployment, or everything else already tried) — ride
+                # the primary
+                return await resolve_primary_only()
+            t_hedge = asyncio.create_task(
+                run(hedge_replica, "hedge", hedge_budget)
+            )
+            owners = {t_primary: primary, t_hedge: hedge_replica}
+            primary_exc: Optional[BaseException] = None
+            hedge_exc: Optional[BaseException] = None
+            pending = set(owners)
+            while pending:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED
+                )
+                for t in done:
+                    target = owners[t]
+                    exc = t.exception()
+                    if exc is None:
+                        result, dt = t.result()
+                        winner = "primary" if t is t_primary else "hedge"
+                        controller._note_attempt_latency(target, dt)
+                        controller._breaker_success(target)
+                        if t is t_hedge and not t_primary.done():
+                            # the primary is about to be cancelled (or
+                            # detached, if probing): not a failure, not
+                            # a sample — but the hedge-loss STREAK is
+                            # the signal that catches a gray replica
+                            # whose own samples hedging dried up
+                            controller._note_hedge_loss(primary)
+                        self._record_hedge(
+                            winner, delay, primary, hedge_replica, method
+                        )
+                        return result
+                    # a GENUINE sub-attempt failure (the loser-cancel
+                    # path never reaches here — cancellation happens in
+                    # the finally below): transport-classified only,
+                    # like every other dispatch path
+                    if not is_caller_timeout(exc) and is_retryable(exc):
+                        controller._breaker_failure(target, exc)
+                    tried.add(target.replica_id)
+                    if t is t_primary:
+                        primary_exc = exc
+                    else:
+                        hedge_exc = exc
+            # both attempts failed — surface the PRIMARY's error so the
+            # outer retry loop classifies exactly what an unhedged
+            # attempt would have raised (the hedge replica already sits
+            # in `tried` for the next failover pick)
+            self._record_hedge(
+                "none", delay, primary, hedge_replica, method
+            )
+            final = primary_exc if primary_exc is not None else hedge_exc
+            raise final
+        finally:
+            if probing and not t_primary.done():
+                detached.add(t_primary)
+                spawn_supervised(
+                    self._settle_probe(t_primary, primary),
+                    name=f"hedge-probe-{self.app_id}-{self.deployment}",
+                    logger=self._controller.logger,
+                )
+            live = [
+                t
+                for t in (t_primary, t_hedge)
+                if t is not None and t not in detached
+            ]
+            for t in live:
+                if not t.done():
+                    t.cancel()
+            # let the cancelled loser unwind its finallys (semaphore
+            # slot, ongoing counter, chip accounting) before returning;
+            # its CancelledError is swallowed HERE and never fed to the
+            # breaker or the outlier EWMA
+            if live:
+                await asyncio.gather(*live, return_exceptions=True)
+
+    async def _settle_probe(self, task: asyncio.Task, target) -> None:
+        """Await a detached probe attempt and bank its evidence: a
+        successful completion feeds the outlier EWMA (the probe's whole
+        point), a genuine transport failure feeds the breaker, and the
+        caller who detached it is long gone either way."""
+        controller = self._controller
+        try:
+            result, dt = await task
+        except asyncio.CancelledError:
+            return
+        except Exception as exc:  # noqa: BLE001 — classified below
+            if not is_caller_timeout(exc) and classify_exception(
+                exc
+            ) is FailureKind.TRANSPORT:
+                controller._breaker_failure(target, exc)
+            return
+        controller._note_attempt_latency(target, dt)
+
+    def _record_hedge(
+        self, winner: str, delay: float, primary, hedge_replica, method: str
+    ) -> None:
+        if metrics.metrics_enabled():
+            REQUEST_HEDGES.labels(self.app_id, self.deployment, winner).inc()
+        flight.record(
+            "request.hedge",
+            app=self.app_id,
+            deployment=self.deployment,
+            method=method,
+            winner=winner,
+            delay_ms=round(delay * 1000.0, 2),
+            primary=primary.replica_id,
+            hedge=hedge_replica.replica_id,
+        )
+
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
@@ -618,6 +907,7 @@ class ServeController:
         log_file: Optional[str] = None,
         breaker_threshold: Optional[int] = None,
         health_check_concurrency: int = 8,
+        outlier_config: Optional[OutlierConfig] = None,
     ):
         self.cluster_state = cluster_state or ClusterState()
         self.health_check_period = health_check_period
@@ -641,6 +931,12 @@ class ServeController:
         self._queue_depth: dict[tuple[str, str], int] = defaultdict(int)
         self._rr_counters: dict[tuple[str, str], itertools.count] = {}
         self._breaker_counts: dict[str, int] = {}
+        # gray-failure defense (serving/outlier.py): per-deployment
+        # latency trackers feeding the PROBATION soft-ejection + the
+        # p95-derived hedge delay; created lazily on first observation,
+        # swept at undeploy like every other router-state dict
+        self.outlier_config = outlier_config or OutlierConfig.from_env()
+        self._outliers: dict[tuple[str, str], DeploymentLatencyTracker] = {}
         # global schedulers, one per deployment that opted in via
         # DeploymentSpec.scheduling; created at deploy, closed at
         # undeploy. scorer_factory is the pluggable placement policy —
@@ -1417,6 +1713,7 @@ class ServeController:
         for name in app.specs:
             self._queue_depth.pop((app_id, name), None)
             self._rr_counters.pop((app_id, name), None)
+            self._outliers.pop((app_id, name), None)
         # observability-state sweep: a dead deployment must not keep
         # alerting or report history as live (get_telemetry races with
         # undeploy by design — see tests/test_slo.py churn test)
@@ -1433,6 +1730,7 @@ class ServeController:
         finally:
             self.cluster_state.mark_replica_dead(replica.replica_id)
             self._breaker_counts.pop(replica.replica_id, None)
+            self._forget_replica_latency(replica.replica_id)
 
     # ---- request routing ----------------------------------------------------
 
@@ -1458,7 +1756,13 @@ class ServeController:
         """Least-loaded routable replica, round-robin tie-break.
         ``avoid`` holds replica_ids that already failed THIS request —
         preferred against, but used as a last resort (the replica may
-        have recovered and being wrong just costs one more retry)."""
+        have recovered and being wrong just costs one more retry).
+
+        PROBATION replicas (latency outliers, serving/outlier.py) are
+        soft-ejected: skipped by the pick except for the trickle probe
+        (every Nth pick routes one real request there so recovery is
+        observed) — and as the last resort when nothing else is
+        routable, because slow beats unavailable."""
         app = self.apps.get(app_id)
         if app is None:
             raise KeyError(f"app '{app_id}' not deployed")
@@ -1474,6 +1778,21 @@ class ServeController:
             raise NoHealthyReplicasError(
                 f"no healthy replicas for {app_id}/{deployment}"
             )
+        probation = [
+            r for r in healthy if r.state == ReplicaState.PROBATION
+        ]
+        normal = [
+            r for r in healthy if r.state != ReplicaState.PROBATION
+        ]
+        if probation and normal:
+            tracker = self._outlier_tracker(app_id, deployment)
+            if tracker.take_probe_ticket():
+                # the probe trickle: route ONE real request to a
+                # probation replica so its latency keeps being measured
+                # — recovery is self-correcting, not operator-driven
+                healthy = probation
+            else:
+                healthy = normal
         min_load = min(r.load for r in healthy)
         candidates = [r for r in healthy if r.load == min_load]
         rr = self._rr_counters.setdefault(
@@ -1560,6 +1879,116 @@ class ServeController:
                 deployment=replica.deployment_name,
             )
 
+    # ---- gray-failure defense (latency outliers → probation) ----------------
+
+    def _outlier_tracker(
+        self, app_id: str, deployment: str
+    ) -> DeploymentLatencyTracker:
+        key = (app_id, deployment)
+        tracker = self._outliers.get(key)
+        if tracker is None:
+            tracker = self._outliers[key] = DeploymentLatencyTracker(
+                app_id, deployment, self.outlier_config
+            )
+        return tracker
+
+    def _note_attempt_latency(self, replica, seconds: float) -> None:
+        """Feed one SUCCESSFUL attempt's service time into the
+        deployment's outlier tracker and apply the probation verdicts
+        it returns (possibly for OTHER replicas of the deployment — a
+        hedged-around gray replica stops producing samples of its own,
+        so its excursion matures on its siblings' notes). Called by the
+        router path, the scheduler's fast path, and group dispatch —
+        never for failed attempts (their wall time measures the
+        transport) and never for cancelled hedge losers (their wall
+        time measures the winner)."""
+        tracker = self._outlier_tracker(
+            replica.app_id, replica.deployment_name
+        )
+        transitions = tracker.note(replica.replica_id, seconds)
+        self._apply_probation_transitions(tracker, replica, transitions)
+
+    def _note_hedge_loss(self, replica) -> None:
+        """A hedge fired against ``replica`` and won. Not a breaker
+        strike, not an EWMA sample — but the tracker counts the streak
+        (see ``note_hedge_loss``) and may return probation verdicts."""
+        tracker = self._outlier_tracker(
+            replica.app_id, replica.deployment_name
+        )
+        transitions = tracker.note_hedge_loss(replica.replica_id)
+        self._apply_probation_transitions(tracker, replica, transitions)
+
+    def _apply_probation_transitions(
+        self, tracker, replica, transitions
+    ) -> None:
+        if not transitions:
+            return
+        app_id = replica.app_id
+        deployment = replica.deployment_name
+        app = self.apps.get(app_id)
+        by_id = {
+            r.replica_id: r
+            for r in (app.replicas.get(deployment, []) if app else [])
+        }
+        by_id.setdefault(replica.replica_id, replica)
+        median = tracker._median()
+        for rid, transition in transitions:
+            target = by_id.get(rid)
+            if target is None:
+                tracker.forget(rid)  # retired mid-flight — stale entry
+                continue
+            ewma = tracker.ewma(rid)
+            # a streak-entered replica may have NO measured EWMA at all
+            # (every completion was a cancelled hedge loser) — the
+            # evidence attrs must tolerate that, not crash the hedged
+            # request that triggered the verdict
+            ewma_s = None if ewma is None else round(ewma, 6)
+            median_s = None if median is None else round(median, 6)
+            if transition == "enter":
+                if target.state != ReplicaState.HEALTHY:
+                    # TESTING replicas are still warming (compile spikes
+                    # are not gray failure) and DRAINING/UNHEALTHY ones
+                    # are already out of the pick — roll the verdict back
+                    tracker.replicas[rid].in_probation = False
+                    continue
+                target.state = ReplicaState.PROBATION
+                self.logger.warning(
+                    f"replica {rid} entered probation: latency EWMA "
+                    f"{ewma_s}s vs deployment median {median_s}s "
+                    f"(gray failure — health checks still pass)"
+                )
+                if metrics.metrics_enabled():
+                    REPLICA_PROBATIONS.labels(app_id, deployment).inc()
+                record_probation_event(
+                    app_id, deployment, rid, "enter",
+                    ewma_s=ewma_s, median_s=median_s,
+                    host=getattr(target, "host_id", None),
+                )
+            elif transition == "exit":
+                if target.state == ReplicaState.PROBATION:
+                    target.state = ReplicaState.HEALTHY
+                    self._replicas_changed.set()
+                self.logger.info(
+                    f"replica {rid} recovered from probation "
+                    f"(EWMA {ewma_s}s, median {median_s}s)"
+                )
+                record_probation_event(
+                    app_id, deployment, rid, "exit",
+                    ewma_s=ewma_s, median_s=median_s,
+                    host=getattr(target, "host_id", None),
+                )
+
+    def _forget_replica_latency(self, replica_id: str) -> None:
+        for tracker in self._outliers.values():
+            tracker.forget(replica_id)
+
+    def hedge_delay_s(
+        self, app_id: str, deployment: str, options: "RequestOptions"
+    ) -> float:
+        if options.hedge_delay_s is not None:
+            return options.hedge_delay_s
+        return self._outlier_tracker(app_id, deployment).hedge_delay_s()
+
     # ---- health + autoscaling loop ------------------------------------------
 
     async def _health_loop(self) -> None:
@@ -1624,6 +2053,7 @@ class ServeController:
                 await r.stop()
                 self.cluster_state.mark_replica_dead(r.replica_id)
                 self._breaker_counts.pop(r.replica_id, None)
+                self._forget_replica_latency(r.replica_id)
                 if r in replicas:
                     replicas.remove(r)
                 try:
@@ -1868,9 +2298,15 @@ class ServeController:
             if cold.get("ttfr_seconds") is not None:
                 last_ttfr = cold
                 break
+        tracker = self._outliers.get((app_id, name))
         return {
             "num_replicas": len(replicas),
             "scheduler": scheduler.describe() if scheduler else None,
+            # latency-outlier view (serving/outlier.py): per-replica
+            # EWMAs vs the deployment median, probation flags, and the
+            # p95-derived hedge delay — the evidence the gray-failure
+            # runbook reads next to `bioengine slo status`
+            "gray_failure": tracker.describe() if tracker else None,
             "cold_start": {
                 "warm_pool": pool.stats() if pool else None,
                 "last_replica_ttfr": last_ttfr,

@@ -413,7 +413,12 @@ class MeshReplica(ReplicaStateMixin):
             self._note_degraded(shard, err)
         elif any(state == ReplicaState.TESTING for _, state, _ in results):
             self.state = ReplicaState.TESTING
-        else:
+        elif self.state != ReplicaState.PROBATION:
+            # gray failure is invisible to health checks by definition:
+            # a controller-assigned PROBATION (latency outlier,
+            # serving/outlier.py) survives an all-shards-healthy check
+            # — only latency evidence from probe traffic clears it
+            # (same guard as Replica/RemoteReplica.check_health)
             self.state = ReplicaState.HEALTHY
         return self.state
 

@@ -121,7 +121,17 @@ class RemoteReplica(ReplicaStateMixin):
                 ),
                 timeout=30.0,
             )
-            self.state = ReplicaState(result["state"])
+            reported = ReplicaState(result["state"])
+            # PROBATION is a CONTROLLER verdict the host-side replica
+            # never hears about — a host reporting "healthy" is exactly
+            # what gray failure looks like, so it must not clear the
+            # soft ejection (latency evidence from probe traffic does);
+            # any non-routable host-side state still wins
+            if not (
+                self.state == ReplicaState.PROBATION
+                and reported in (ReplicaState.HEALTHY, ReplicaState.TESTING)
+            ):
+                self.state = reported
             if result.get("last_error"):
                 self.last_error = result["last_error"]
         except Exception as e:
@@ -178,6 +188,7 @@ class RemoteReplica(ReplicaStateMixin):
         if self.state in (
             ReplicaState.HEALTHY,
             ReplicaState.TESTING,
+            ReplicaState.PROBATION,
             ReplicaState.DRAINING,
         ):
             await self.drain(drain_timeout_s)

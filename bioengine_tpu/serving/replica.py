@@ -70,11 +70,25 @@ class ReplicaState(str, enum.Enum):
     TESTING = "TESTING"
     HEALTHY = "HEALTHY"
     UNHEALTHY = "UNHEALTHY"
+    # gray failure: alive and passing health checks but a latency
+    # outlier vs its deployment siblings (serving/outlier.py). Routable
+    # — the replica CAN serve — but the router/scheduler soft-eject it
+    # from the scored pick, sending only a trickle of probe traffic
+    # until its latency recovers. Assigned controller-side (like
+    # breaker ejections); health checks preserve it, latency evidence
+    # clears it.
+    PROBATION = "PROBATION"
     DRAINING = "DRAINING"          # no new calls; in-flight may finish
     STOPPED = "STOPPED"
 
-# states a DeploymentHandle may route new calls to
-ROUTABLE_STATES = (ReplicaState.HEALTHY, ReplicaState.TESTING)
+# states a replica will EXECUTE new calls in (PROBATION serves probe /
+# last-resort traffic — slow is not dead); the router and scheduler
+# additionally skip PROBATION in their scored picks
+ROUTABLE_STATES = (
+    ReplicaState.HEALTHY,
+    ReplicaState.TESTING,
+    ReplicaState.PROBATION,
+)
 
 
 class ReplicaStateMixin:
@@ -301,7 +315,12 @@ class Replica(ReplicaStateMixin):
         if hasattr(self.instance, "check_health"):
             try:
                 await _maybe_await(self.instance.check_health())
-                self.state = ReplicaState.HEALTHY
+                # gray failure is INVISIBLE to health checks by
+                # definition — a passing check must not clear a
+                # controller-assigned PROBATION; only latency evidence
+                # from probe traffic does (serving/outlier.py)
+                if self.state != ReplicaState.PROBATION:
+                    self.state = ReplicaState.HEALTHY
             except Exception as e:
                 self.last_error = str(e)
                 self.state = ReplicaState.UNHEALTHY
@@ -315,6 +334,7 @@ class Replica(ReplicaStateMixin):
         if self.state in (
             ReplicaState.HEALTHY,
             ReplicaState.TESTING,
+            ReplicaState.PROBATION,
             ReplicaState.INITIALIZING,
         ):
             self.state = ReplicaState.DRAINING
@@ -354,6 +374,7 @@ class Replica(ReplicaStateMixin):
         if self.state in (
             ReplicaState.HEALTHY,
             ReplicaState.TESTING,
+            ReplicaState.PROBATION,
             ReplicaState.DRAINING,
         ):
             await self.drain(drain_timeout_s)

@@ -62,6 +62,7 @@ from bioengine_tpu.serving.errors import (
 from bioengine_tpu.serving.replica import (
     DEFAULT_DRAIN_TIMEOUT_S,
     ROUTABLE_STATES,
+    ReplicaState,
 )
 from bioengine_tpu.utils import flight, metrics, tracing
 from bioengine_tpu.utils.tasks import spawn_supervised
@@ -290,11 +291,13 @@ class HeuristicCostModel:
         breaker_penalty: float = 0.5,
         affinity_bonus: float = 0.15,
         avoid_penalty: float = 10.0,
+        probation_penalty: float = 20.0,
     ):
         self.queued_weight = queued_weight
         self.breaker_penalty = breaker_penalty
         self.affinity_bonus = affinity_bonus
         self.avoid_penalty = avoid_penalty
+        self.probation_penalty = probation_penalty
 
     def score(self, features: dict) -> float:
         s = float(features.get("load", 0.0))
@@ -306,6 +309,11 @@ class HeuristicCostModel:
             s -= self.affinity_bonus
         if features.get("avoided"):
             s += self.avoid_penalty
+        if features.get("probation"):
+            # latency outlier (gray failure): soft ejection — scored
+            # far behind every healthy sibling, above only nothing at
+            # all (the trickle probe bypasses scoring entirely)
+            s += self.probation_penalty
         return s
 
 
@@ -700,15 +708,36 @@ class DeploymentScheduler:
         """ONE scored argmin over the routable replicas — the single
         place the scorer's feature contract is built, shared by the
         fast path and the group-dispatch pick so the two can never
-        drift. None when no routable replica exists right now."""
+        drift. None when no routable replica exists right now.
+
+        PROBATION replicas (latency outliers) ride the same contract:
+        the ``probation`` feature lets any scorer — heuristic or
+        learned — price the soft ejection, and the trickle probe
+        (every Nth pick, serving/outlier.py) bypasses scoring entirely
+        so recovery keeps being measured with real traffic."""
         app = self.controller.apps.get(self.app_id)
         if app is None:
             return None
+        candidates = [
+            r
+            for r in app.replicas.get(self.deployment, [])
+            if r.state in ROUTABLE_STATES
+        ]
+        probation = [
+            r for r in candidates if r.state == ReplicaState.PROBATION
+        ]
+        if probation and len(probation) < len(candidates):
+            tracker = self.controller._outlier_tracker(
+                self.app_id, self.deployment
+            )
+            if tracker.take_probe_ticket():
+                pool = [
+                    r for r in probation if r.replica_id not in avoid
+                ] or probation
+                return pool[tracker._probe_tick % len(pool)]
         best = None
         best_score = None
-        for r in app.replicas.get(self.deployment, []):
-            if r.state not in ROUTABLE_STATES:
-                continue
+        for r in candidates:
             s = self.scorer.score(
                 {
                     "load": r.load,
@@ -721,6 +750,7 @@ class DeploymentScheduler:
                         self._last_signature.get(r.replica_id) == signature
                     ),
                     "avoided": r.replica_id in avoid,
+                    "probation": r.state == ReplicaState.PROBATION,
                     "group_size": group_size,
                 }
             )
@@ -766,6 +796,11 @@ class DeploymentScheduler:
             raise
         else:
             self.controller._breaker_success(replica)
+            # successful service time feeds the gray-failure outlier
+            # EWMA — same evidence stream as the router path
+            self.controller._note_attempt_latency(
+                replica, time.monotonic() - t0
+            )
             self._last_signature[replica.replica_id] = signature
             self._prune_affinity()
             self.predictor.note_service(
@@ -1041,6 +1076,9 @@ class DeploymentScheduler:
         wall = time.monotonic() - t0
         self._last_signature[replica.replica_id] = signature
         self._prune_affinity()
+        # one outlier-EWMA sample per dispatched group: the group wall
+        # is the service time every member experienced on this replica
+        self.controller._note_attempt_latency(replica, wall)
         self.predictor.note_service(
             len(live), wall, reground=any(r.probe for r in live)
         )

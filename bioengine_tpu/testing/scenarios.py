@@ -1,0 +1,1072 @@
+"""Deterministic scenario engine — replayable synthetic incidents.
+
+The chaos tests (tests/test_chaos.py) prove single failure modes with
+hand-written choreography. This module generalizes them into a
+**seeded, deterministic workload driver** over the same in-process
+multi-host harness: a scenario composes a *load shape* (constant,
+diurnal wave, bursts, tenant flood, hot-key signature skew) with a
+*fault script* built on :mod:`bioengine_tpu.testing.faults` (gray
+failure = seeded ``slow_ramp`` at ``host.replica_call``, preemption
+storm = repeated host kills + respawns, blip storm = connection drops),
+runs it time-compressed (ticks of ~10-20 ms), and checks a set of
+declarative **invariants** when the run settles — zero failed
+idempotent requests, exact chip accounting, no stuck pending futures,
+bounded queue depths, an SLO-attainment floor, tail-latency recovery.
+
+Everything the workload does derives from ONE seed: arrivals per tick
+are a pure function of the load shape, request arguments come from a
+``random.Random(seed)``, fault windows live in tick space, and the
+slow-ramp delay sequence replays exactly under its derived seed. The
+**request outcome sequence** — the per-request outcome class, ordered
+by request index — is therefore identical across runs with the same
+seed, and so are the invariant verdicts; ``outcome_signature`` distills
+both into one comparable string (the CI determinism gate diffs it
+across a double run).
+
+One normalization keeps that guarantee honest: a stream marked
+``strict=False`` (the flood tenant in ``tenant_flood``) records
+``absorbed`` for both *served* and *shed* — best-effort flood traffic's
+contract is "must not break protected traffic", and whether one flood
+request squeaked through before the queue filled is timing the
+scenario deliberately does not pin. Strict streams record their real
+outcome class, always.
+
+Scenarios run with defenses ON (probation + hedging, the default) or
+OFF (``defenses=False``) — the ``slow_replica`` scenario run both ways
+is the acceptance proof for the gray-failure machinery: same seed, same
+injected degradation; with defenses the tail recovers and nothing
+fails, without them the ``p99_recovery`` invariant goes red.
+
+Entry points: :func:`run_scenario` (sync, used by the CLI / bench /
+CI) and :func:`run_scenario_async` (tests already inside a loop).
+``BIOENGINE_SCENARIO_SCALE`` stretches every time constant for slow
+machines (2.0 = twice as slow, twice as patient).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+from bioengine_tpu.testing import faults
+from bioengine_tpu.utils import flight
+from bioengine_tpu.utils.logger import create_logger
+
+logger = create_logger("scenarios", log_file="off")
+
+# ---------------------------------------------------------------------------
+# scenario vocabulary
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Stream:
+    """One deterministic arrival process. ``arrivals(tick)`` is a pure
+    function — no RNG — so the request plan replays exactly."""
+
+    name: str = "main"
+    tenant: Optional[str] = None
+    priority: Optional[str] = None
+    strict: bool = True          # False → ok and shed both record "absorbed"
+    idempotent: bool = True
+    kind: str = "constant"       # constant | diurnal | burst
+    base: int = 2                # arrivals per tick
+    amplitude: int = 0           # diurnal peak above base
+    period: int = 40             # diurnal period in ticks
+    burst_every: int = 0
+    burst_size: int = 0
+    start_tick: int = 0
+    end_tick: Optional[int] = None
+    skew_keys: int = 0           # >0 → hot-key argument skew (signature skew)
+    deadline_s: Optional[float] = None
+
+    def arrivals(self, tick: int) -> int:
+        if tick < self.start_tick:
+            return 0
+        if self.end_tick is not None and tick >= self.end_tick:
+            return 0
+        n = self.base
+        if self.kind == "diurnal":
+            n = round(
+                self.base
+                + self.amplitude
+                * 0.5
+                * (1.0 + math.sin(2.0 * math.pi * tick / self.period))
+            )
+        elif (
+            self.kind == "burst"
+            and self.burst_every
+            and tick % self.burst_every == 0
+        ):
+            n += self.burst_size
+        return max(0, n)
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One scripted incident step, pinned to a tick."""
+
+    at_tick: int
+    action: str                  # kill_host | respawn_host | slow_ramp | blip | clear_faults
+    host: Optional[str] = None
+    delay_s: float = 0.2         # slow_ramp target delay
+    ramp_hits: int = 12          # slow_ramp hits to reach full delay
+    point: str = "host.replica_call"
+
+
+@dataclass(frozen=True)
+class Scenario:
+    name: str
+    description: str
+    ticks: int = 80
+    tick_s: float = 0.015
+    health_every: int = 3        # controller.health_tick cadence, in ticks
+    # topology: n_hosts > 0 → remote replicas over real websockets
+    # (chips_per_replica forces remote placement); 0 → local replicas
+    n_hosts: int = 0
+    n_replicas: int = 2
+    chips_per_replica: int = 2
+    max_ongoing: int = 16
+    service_s: float = 0.008     # synthetic deployment's forward time
+    scheduling: Optional[dict] = None   # SchedulingConfig kwargs → scheduler path
+    streams: tuple = (Stream(),)
+    fault_script: tuple = ()
+    hedge: bool = True           # defenses leg hedges idempotent traffic
+    deadline_s: float = 15.0
+    max_attempts: int = 8
+    slo_ms: float = 250.0
+    slo_floor: float = 0.9
+    # invariants: always required / required only when defenses are on
+    invariants: tuple = (
+        "zero_failed_idempotent",
+        "chip_accounting_exact",
+        "no_stuck_futures",
+        "bounded_queues",
+    )
+    defended_invariants: tuple = ()
+    # p99_recovery phases: requests issued before first fault tick are
+    # the healthy baseline; the last `recovery_tail` requests the tail
+    recovery_tail: int = 60
+    recovery_factor: float = 2.0
+    # outlier-detector overrides for the defenses leg (time-compressed)
+    outlier: dict = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# the synthetic deployment
+# ---------------------------------------------------------------------------
+
+_MANIFEST = """\
+name: Scenario App
+id: scenario-app
+id_emoji: "\\U0001F9EA"
+description: deterministic idempotent arithmetic for scenario traffic
+type: tpu-serve
+version: 1.0.0
+deployments:
+  - scenario_dep:ScenarioDep
+authorized_users: ["*"]
+deployment_config:
+  scenario_dep:
+    num_replicas: {n_replicas}
+    min_replicas: {n_replicas}
+    max_replicas: {n_replicas}
+    chips: {chips}
+    autoscale: false
+"""
+
+_SOURCE = """\
+import asyncio
+
+from bioengine_tpu.rpc import schema_method
+
+
+class ScenarioDep:
+    service_s = {service_s}
+
+    def __init__(self):
+        self.calls = 0
+
+    @schema_method
+    async def work(self, a: int, b: int, context=None):
+        \"\"\"Idempotent arithmetic with a fixed service time.\"\"\"
+        self.calls += 1
+        await asyncio.sleep(self.service_s)
+        return {{"sum": a + b}}
+"""
+
+
+class _LocalDep:
+    """Local-replica variant for host-less (scheduler-path) scenarios."""
+
+    service_s = 0.008
+
+    async def work(self, a: int = 0, b: int = 0):
+        await asyncio.sleep(type(self).service_s)
+        return {"sum": a + b}
+
+
+def _build_app_dir(root: Path, scenario: Scenario) -> Path:
+    """Sync helper (driven via ``asyncio.to_thread``): writes the
+    scenario app's manifest + source for the AppBuilder."""
+    app_dir = root / "scenario-src"
+    app_dir.mkdir(parents=True, exist_ok=True)
+    (app_dir / "manifest.yaml").write_text(
+        _MANIFEST.format(
+            n_replicas=scenario.n_replicas, chips=scenario.chips_per_replica
+        )
+    )
+    (app_dir / "scenario_dep.py").write_text(
+        _SOURCE.format(service_s=scenario.service_s)
+    )
+    return app_dir
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+
+def _scale() -> float:
+    try:
+        return max(0.1, float(os.environ.get("BIOENGINE_SCENARIO_SCALE", "1")))
+    except ValueError:
+        return 1.0
+
+
+def _quantile(vals: list, q: float) -> Optional[float]:
+    if not vals:
+        return None
+    s = sorted(vals)
+    return s[min(int(len(s) * q), len(s) - 1)]
+
+
+async def _kill_host(host) -> None:
+    """In-process SIGKILL: sever the websocket with rejoin suppressed."""
+    host.rejoin = False
+    if host.connection is not None:
+        host.connection.auto_reconnect = False
+        host.connection._closing = True
+        await host.connection._abort_connection()
+
+
+class _Plane:
+    """The in-process serving plane a scenario drives: controller (+
+    optional RpcServer and WorkerHosts), the deployed scenario app, and
+    fault-script application."""
+
+    def __init__(self, scenario: Scenario, seed: int, defenses: bool,
+                 scale: float, workdir: Path):
+        self.scenario = scenario
+        self.seed = seed
+        self.defenses = defenses
+        self.scale = scale
+        self.workdir = workdir
+        self.server = None
+        self.controller = None
+        self.hosts: dict[str, Any] = {}
+        self.dead_hosts: dict[str, Any] = {}
+        self._token = None
+        self.app_id = "scenario-app"
+        self.deployment = "scenario_dep"
+
+    async def start(self) -> None:
+        from bioengine_tpu.cluster.state import ClusterState
+        from bioengine_tpu.cluster.topology import TpuTopology
+        from bioengine_tpu.serving import (
+            DeploymentSpec,
+            OutlierConfig,
+            SchedulingConfig,
+            ServeController,
+        )
+
+        s = self.scenario
+        outlier_kwargs = {
+            # time-compressed defaults sized to the tick scale; a
+            # scenario may override any of them
+            "ratio": 2.5,
+            "recovery_ratio": 1.6,
+            "excursion_s": 0.25 * self.scale,
+            "min_samples": 6,
+            "probe_every": 6,
+            "ewma_alpha": 0.35,
+            **s.outlier,
+        }
+        outlier = OutlierConfig(enabled=self.defenses, **outlier_kwargs)
+        if s.n_hosts > 0:
+            from bioengine_tpu.rpc.server import RpcServer
+
+            self.server = RpcServer(host="127.0.0.1", admin_users=["admin"])
+            await self.server.start()
+            self._token = self.server.issue_token("admin", is_admin=True)
+            self.controller = ServeController(
+                ClusterState(
+                    TpuTopology(chips=(), n_hosts=1, platform="cpu")
+                ),
+                health_check_period=3600,
+                outlier_config=outlier,
+            )
+            self.controller.attach_rpc(self.server, admin_users=["admin"])
+            for i in range(s.n_hosts):
+                await self.spawn_host(f"h{i + 1}")
+            await self._deploy_remote()
+        else:
+            self.controller = ServeController(
+                ClusterState(), health_check_period=3600,
+                outlier_config=outlier,
+            )
+            _LocalDep.service_s = s.service_s
+            scheduling = (
+                SchedulingConfig(**s.scheduling)
+                if s.scheduling is not None
+                else None
+            )
+            await self.controller.deploy(
+                self.app_id,
+                [
+                    DeploymentSpec(
+                        name=self.deployment,
+                        instance_factory=_LocalDep,
+                        num_replicas=s.n_replicas,
+                        min_replicas=s.n_replicas,
+                        max_replicas=s.n_replicas,
+                        max_ongoing_requests=s.max_ongoing,
+                        autoscale=False,
+                        scheduling=scheduling,
+                    )
+                ],
+            )
+
+    async def spawn_host(self, host_id: str):
+        from bioengine_tpu.worker_host import WorkerHost
+
+        host = WorkerHost(
+            server_url=self.server.url,
+            token=self._token,
+            host_id=host_id,
+            workspace_dir=self.workdir / f"ws-{host_id}",
+            rejoin=True,
+        )
+        await host.start()
+        if host.connection is not None:
+            host.connection.reconnect_max_backoff_s = 0.5
+        self.hosts[host_id] = host
+        self.dead_hosts.pop(host_id, None)
+        return host
+
+    async def _deploy_remote(self) -> None:
+        from bioengine_tpu.apps.builder import AppBuilder
+
+        app_dir = await asyncio.to_thread(
+            _build_app_dir, self.workdir, self.scenario
+        )
+
+        def _build():
+            builder = AppBuilder(workdir_root=self.workdir / "apps")
+            return builder.build(app_id=self.app_id, local_path=app_dir)
+
+        built = await asyncio.to_thread(_build)
+        await self.controller.deploy(self.app_id, built.specs)
+
+    async def apply(self, ev: FaultEvent, seed: int) -> None:
+        if ev.action == "kill_host":
+            host = self.hosts.pop(ev.host, None)
+            if host is not None:
+                self.dead_hosts[ev.host] = host
+                await _kill_host(host)
+        elif ev.action == "respawn_host":
+            old = self.dead_hosts.pop(ev.host, None)
+            if old is not None:
+                try:
+                    await old.stop()
+                except Exception as e:  # noqa: BLE001 — already-severed host
+                    logger.debug(f"stop of killed host {ev.host}: {e}")
+            await self.spawn_host(ev.host)
+        elif ev.action == "slow_ramp":
+            import zlib
+
+            faults.configure(
+                ev.point,
+                "slow_ramp",
+                scope=ev.host,
+                delay_s=ev.delay_s * self.scale,
+                # derived, not shared: the ramp's jitter stream must not
+                # depend on how many other points the scenario armed.
+                # crc32, NOT hash() — str hashing is randomized per
+                # interpreter (PYTHONHASHSEED), which would break the
+                # replay-exactly contract ACROSS invocations while the
+                # in-process double run still passed
+                seed=seed
+                ^ (zlib.crc32((ev.host or "").encode()) & 0xFFFF)
+                ^ ev.at_tick,
+                ramp_hits=ev.ramp_hits,
+            )
+        elif ev.action == "blip":
+            host = self.hosts.get(ev.host)
+            if host is not None and host.connection is not None:
+                await host.connection._abort_connection()
+        elif ev.action == "clear_faults":
+            faults.clear(ev.point)
+        else:
+            raise ValueError(f"unknown fault action '{ev.action}'")
+
+    async def stop(self) -> None:
+        for host in list(self.hosts.values()) + list(self.dead_hosts.values()):
+            try:
+                await host.stop()
+            except Exception as e:  # noqa: BLE001 — teardown best effort
+                logger.debug(f"host {host.host_id} teardown: {e}")
+        if self.controller is not None:
+            await self.controller.stop()
+        if self.server is not None:
+            await self.server.stop()
+
+
+async def run_scenario_async(
+    scenario: Scenario,
+    seed: int = 0,
+    defenses: bool = True,
+    workdir: Optional[Path] = None,
+) -> dict:
+    """Run one scenario to completion and evaluate its invariants.
+    Returns the result artifact (see module docstring); raises nothing
+    on invariant failure — ``result["passed"]`` is the verdict."""
+    import tempfile
+
+    from bioengine_tpu.serving import RequestOptions
+    from bioengine_tpu.serving.errors import (
+        AdmissionRejectedError,
+        DeadlineExceeded,
+    )
+
+    scale = _scale()
+    s = scenario
+    rng = random.Random(seed)
+    owns_workdir = workdir is None
+    if owns_workdir:
+        workdir = Path(
+            await asyncio.to_thread(tempfile.mkdtemp, prefix="bioengine-scn-")
+        )
+    flight_t0 = time.time()
+    faults.clear()
+    plane = _Plane(s, seed, defenses, scale, workdir)
+
+    # ---- deterministic request plan (pure function of seed) ----------------
+    plan: list[dict] = []
+    for tick in range(s.ticks):
+        for stream in s.streams:
+            for _ in range(stream.arrivals(tick)):
+                if stream.skew_keys:
+                    # hot-key skew: 80% of traffic shares one argument
+                    # tuple (one batch signature — signatures hash the
+                    # scalar VALUES), the rest spreads over cold keys
+                    a = (
+                        0
+                        if rng.random() < 0.8
+                        else 1 + rng.randrange(stream.skew_keys)
+                    )
+                    b = 1
+                else:
+                    a = rng.randrange(1000)
+                    b = rng.randrange(1000)
+                plan.append(
+                    {
+                        "idx": len(plan),
+                        "tick": tick,
+                        "stream": stream,
+                        "a": a,
+                        "b": b,
+                    }
+                )
+
+    outcomes: list[Optional[str]] = [None] * len(plan)
+    latencies: list[Optional[float]] = [None] * len(plan)
+    queue_samples: list[int] = []
+
+    try:
+        await plane.start()
+        handle = plane.controller.get_handle(plane.app_id, plane.deployment)
+        fault_by_tick: dict[int, list[FaultEvent]] = {}
+        for ev in s.fault_script:
+            fault_by_tick.setdefault(ev.at_tick, []).append(ev)
+
+        def opts_for(req: dict) -> RequestOptions:
+            stream = req["stream"]
+            return RequestOptions(
+                idempotent=stream.idempotent,
+                deadline_s=(stream.deadline_s or s.deadline_s) * scale,
+                max_attempts=s.max_attempts,
+                backoff_base_s=0.02,
+                backoff_cap_s=0.25,
+                priority=stream.priority,
+                tenant=stream.tenant,
+                hedge=defenses and s.hedge and stream.idempotent,
+            )
+
+        async def one(req: dict) -> None:
+            idx = req["idx"]
+            t0 = time.monotonic()
+            try:
+                r = await handle.call(
+                    "work", req["a"], req["b"], options=opts_for(req)
+                )
+                got = r["sum"] if isinstance(r, dict) else None
+                outcomes[idx] = (
+                    "ok" if got == req["a"] + req["b"] else "wrong_result"
+                )
+            except AdmissionRejectedError:
+                outcomes[idx] = "shed"
+            except DeadlineExceeded:
+                outcomes[idx] = "deadline"
+            except Exception as e:  # noqa: BLE001 — the outcome IS the datum
+                outcomes[idx] = f"failed:{type(e).__name__}"
+            latencies[idx] = time.monotonic() - t0
+
+        by_tick: dict[int, list[dict]] = {}
+        for req in plan:
+            by_tick.setdefault(req["tick"], []).append(req)
+
+        t_run = time.monotonic()
+        tasks: list[asyncio.Task] = []
+        for tick in range(s.ticks):
+            for ev in fault_by_tick.get(tick, ()):
+                await plane.apply(ev, seed)
+            for req in by_tick.get(tick, ()):
+                tasks.append(asyncio.create_task(one(req)))
+            await asyncio.sleep(s.tick_s * scale)
+            queue_samples.append(
+                sum(plane.controller._queue_depth.values())
+            )
+            if tick % s.health_every == 0:
+                await plane.controller.health_tick()
+        # drain: every request finishes (deadlines bound this), then the
+        # plane settles so leak checks see steady state, not shutdown
+        await asyncio.gather(*tasks)
+        for _ in range(3):
+            await plane.controller.health_tick()
+            await asyncio.sleep(0.05 * scale)
+        # detached hedge probes (a probation replica is slow by
+        # definition) may still be settling — give the RPC plane a
+        # bounded window to drain before the leak invariants look
+        settle_until = time.monotonic() + 3.0 * scale
+        while time.monotonic() < settle_until:
+            pending = len(plane.server._pending) if plane.server else 0
+            if not pending:
+                break
+            await asyncio.sleep(0.02)
+        wall = time.monotonic() - t_run
+
+        result = _evaluate(
+            s, seed, defenses, plane, plan, outcomes, latencies,
+            queue_samples, flight_t0, wall,
+        )
+        return result
+    finally:
+        faults.clear()
+        await plane.stop()
+        if owns_workdir:
+            import shutil
+
+            await asyncio.to_thread(shutil.rmtree, workdir, True)
+
+
+def run_scenario(
+    scenario: Scenario, seed: int = 0, defenses: bool = True
+) -> dict:
+    return asyncio.run(run_scenario_async(scenario, seed, defenses))
+
+
+# ---------------------------------------------------------------------------
+# invariants
+# ---------------------------------------------------------------------------
+
+
+def _evaluate(
+    s: Scenario,
+    seed: int,
+    defenses: bool,
+    plane: _Plane,
+    plan: list,
+    outcomes: list,
+    latencies: list,
+    queue_samples: list,
+    flight_t0: float,
+    wall: float,
+) -> dict:
+    # normalized outcome sequence: strict streams record the real
+    # class; best-effort streams (flood) collapse served/shed into
+    # "absorbed" (the contract they are held to — see module docstring)
+    seq = []
+    for req, out in zip(plan, outcomes):
+        if not req["stream"].strict and out in ("ok", "shed", "deadline"):
+            seq.append("absorbed")
+        else:
+            seq.append(out)
+
+    probation_events = flight.get_events(
+        types=("replica.probation",), since=flight_t0
+    )
+    hedge_events = flight.get_events(
+        types=("request.hedge",), since=flight_t0
+    )
+
+    strict_lat = [
+        1000.0 * lat
+        for req, lat, out in zip(plan, latencies, outcomes)
+        if req["stream"].strict and out == "ok" and lat is not None
+    ]
+    first_fault_tick = min(
+        (ev.at_tick for ev in s.fault_script), default=None
+    )
+    base_lat = [
+        1000.0 * lat
+        for req, lat, out in zip(plan, latencies, outcomes)
+        if first_fault_tick is not None
+        and req["tick"] < first_fault_tick
+        and req["stream"].strict
+        and out == "ok"
+        and lat is not None
+    ]
+    tail_lat = [
+        1000.0 * lat
+        for req, lat, out in list(zip(plan, latencies, outcomes))[
+            -s.recovery_tail:
+        ]
+        if req["stream"].strict and out == "ok" and lat is not None
+    ]
+
+    checks: dict[str, Callable[[], tuple[bool, str]]] = {
+        "zero_failed_idempotent": lambda: _inv_zero_failed(plan, outcomes),
+        "chip_accounting_exact": lambda: _inv_chips(plane),
+        "no_stuck_futures": lambda: _inv_no_stuck(plane),
+        "bounded_queues": lambda: _inv_bounded_queues(
+            s, plane, queue_samples
+        ),
+        "slo_attainment": lambda: _inv_slo(s, strict_lat),
+        "p99_recovery": lambda: _inv_recovery(s, base_lat, tail_lat),
+        "probation_entered": lambda: (
+            any(e["attrs"].get("phase") == "enter" for e in probation_events),
+            f"{len(probation_events)} probation event(s)",
+        ),
+        "coalescing_observed": lambda: _inv_coalescing(plane),
+        "flood_shed_observed": lambda: _inv_flood_shed(plane),
+    }
+
+    invariants: dict[str, dict] = {}
+    for name in dict.fromkeys(
+        (*s.invariants, *s.defended_invariants)
+    ):
+        ok, detail = checks[name]()
+        invariants[name] = {
+            "ok": bool(ok),
+            "required": name in s.invariants
+            or (defenses and name in s.defended_invariants),
+            "detail": detail,
+        }
+
+    counts: dict[str, int] = {}
+    for out in seq:
+        counts[out] = counts.get(out, 0) + 1
+    return {
+        "scenario": s.name,
+        "seed": seed,
+        "defenses": defenses,
+        "requests": len(plan),
+        "wall_s": round(wall, 3),
+        "counts": counts,
+        "outcomes": seq,
+        "invariants": invariants,
+        "passed": all(
+            v["ok"] for v in invariants.values() if v["required"]
+        ),
+        "latency_ms": {
+            "p50": round(_quantile(strict_lat, 0.5) or 0.0, 2),
+            "p95": round(_quantile(strict_lat, 0.95) or 0.0, 2),
+            "p99": round(_quantile(strict_lat, 0.99) or 0.0, 2),
+        },
+        "phases": {
+            "baseline_p99_ms": round(_quantile(base_lat, 0.99) or 0.0, 2),
+            "tail_p99_ms": round(_quantile(tail_lat, 0.99) or 0.0, 2),
+        },
+        "probations": sum(
+            1
+            for e in probation_events
+            if e["attrs"].get("phase") == "enter"
+        ),
+        "hedges": len(hedge_events),
+    }
+
+
+def outcome_signature(result: dict) -> str:
+    """The determinism fingerprint: outcome sequence + invariant
+    verdicts (NOT latencies — wall time is the one thing a replay may
+    legitimately change)."""
+    verdicts = ",".join(
+        f"{k}={int(v['ok'])}" for k, v in sorted(result["invariants"].items())
+    )
+    return "|".join(result["outcomes"]) + "#" + verdicts
+
+
+def _inv_zero_failed(plan, outcomes) -> tuple[bool, str]:
+    bad = [
+        (req["idx"], out)
+        for req, out in zip(plan, outcomes)
+        if req["stream"].strict
+        and req["stream"].idempotent
+        and out != "ok"
+    ]
+    return not bad, f"{len(bad)} failed idempotent request(s): {bad[:5]}"
+
+
+def _inv_chips(plane: _Plane) -> tuple[bool, str]:
+    state = plane.controller.cluster_state
+    problems = []
+    live_replicas = {
+        r.replica_id: r
+        for app in plane.controller.apps.values()
+        for reps in app.replicas.values()
+        for r in reps
+    }
+    for host in state.hosts.values():
+        if not host.alive and host.chips_in_use:
+            problems.append(f"dead host {host.host_id} leaks leases")
+        for chip, rid in host.chips_in_use.items():
+            if rid not in live_replicas:
+                problems.append(
+                    f"chip {chip} on {host.host_id} leased by dead {rid}"
+                )
+    for rid, r in live_replicas.items():
+        host_id = getattr(r, "host_id", None)
+        if host_id is None or not r.device_ids:
+            continue
+        host = state.hosts.get(host_id)
+        held = (
+            [c for c, owner in host.chips_in_use.items() if owner == rid]
+            if host
+            else []
+        )
+        if host is None or sorted(held) != sorted(r.device_ids):
+            problems.append(
+                f"{rid} lease mismatch on {host_id}: "
+                f"{held} vs {r.device_ids}"
+            )
+    return not problems, "; ".join(problems) or "exact"
+
+
+def _inv_no_stuck(plane: _Plane) -> tuple[bool, str]:
+    from bioengine_tpu.utils import tasks as task_registry
+
+    problems = []
+    if plane.server is not None and plane.server._pending:
+        problems.append(f"server pending: {len(plane.server._pending)}")
+    for host_id, host in plane.hosts.items():
+        conn = host.connection
+        if conn is not None and conn._pending:
+            problems.append(f"{host_id} pending: {len(conn._pending)}")
+    for key, sched in plane.controller._schedulers.items():
+        if sched.waiting or sched._open or sched._inflight:
+            problems.append(
+                f"scheduler {key}: waiting={sched.waiting} "
+                f"open={len(sched._open)} inflight={len(sched._inflight)}"
+            )
+    lingering = [
+        t for t in task_registry._BACKGROUND_TASKS if not t.done()
+    ]
+    if len(lingering) > 16:
+        problems.append(f"{len(lingering)} lingering supervised tasks")
+    return not problems, "; ".join(problems) or "drained"
+
+
+def _inv_bounded_queues(
+    s: Scenario, plane: _Plane, queue_samples: list
+) -> tuple[bool, str]:
+    bound = s.n_replicas * s.max_ongoing * 4
+    peak = max(queue_samples, default=0)
+    final = sum(plane.controller._queue_depth.values())
+    ok = peak <= bound and final == 0
+    return ok, f"peak={peak} bound={bound} final={final}"
+
+
+def _inv_slo(s: Scenario, strict_lat: list) -> tuple[bool, str]:
+    if not strict_lat:
+        return False, "no successful strict requests"
+    met = sum(1 for v in strict_lat if v <= s.slo_ms * _scale())
+    frac = met / len(strict_lat)
+    return (
+        frac >= s.slo_floor,
+        f"{100 * frac:.1f}% <= {s.slo_ms}ms (floor {100 * s.slo_floor:.0f}%)",
+    )
+
+
+def _inv_recovery(
+    s: Scenario, base_lat: list, tail_lat: list
+) -> tuple[bool, str]:
+    if not base_lat or not tail_lat:
+        return False, "missing baseline or tail window"
+    base = _quantile(base_lat, 0.99)
+    tail = _quantile(tail_lat, 0.99)
+    # floor the baseline at one service time: an empty-queue baseline
+    # p99 can sit below the service sleep on a quiet run
+    floor = max(base, 1000.0 * s.service_s * _scale())
+    ok = tail <= s.recovery_factor * floor
+    return ok, (
+        f"tail_p99={tail:.1f}ms vs {s.recovery_factor}x "
+        f"baseline_p99={base:.1f}ms"
+    )
+
+
+def _inv_coalescing(plane: _Plane) -> tuple[bool, str]:
+    stats = {
+        k: dict(sched.stats)
+        for k, sched in plane.controller._schedulers.items()
+    }
+    grouped = sum(
+        st["dispatched_requests"] - st["dispatched_groups"]
+        for st in stats.values()
+    )
+    return grouped > 0, f"requests coalesced beyond groups: {grouped}"
+
+
+def _inv_flood_shed(plane: _Plane) -> tuple[bool, str]:
+    shed = sum(
+        sched.stats["rejected"]
+        for sched in plane.controller._schedulers.values()
+    )
+    return shed > 0, f"admission rejections: {shed}"
+
+
+# ---------------------------------------------------------------------------
+# named scenarios
+# ---------------------------------------------------------------------------
+
+NAMED_SCENARIOS: dict[str, Scenario] = {}
+
+
+def _register(s: Scenario) -> Scenario:
+    NAMED_SCENARIOS[s.name] = s
+    return s
+
+
+# THE acceptance scenario: one host's replica gray-fails (seeded
+# slow-ramp — still passing health checks) a third of the way in and
+# never heals; with defenses the outlier detector puts it in probation,
+# hedges rescue the in-window tail, and deployment p99 returns to
+# within 2x the healthy baseline with zero failed idempotent requests.
+# With defenses OFF the same seed shows the degradation (p99_recovery
+# goes red) — proving the scenario detects what the machinery fixes.
+SLOW_REPLICA = _register(
+    Scenario(
+        name="slow_replica",
+        description=(
+            "gray failure: seeded slow-ramp on one host's replica path; "
+            "probation + hedging steer around it"
+        ),
+        ticks=110,
+        tick_s=0.015,
+        n_hosts=3,
+        n_replicas=3,
+        chips_per_replica=2,
+        service_s=0.008,
+        streams=(Stream(base=3),),
+        fault_script=(
+            FaultEvent(at_tick=30, action="slow_ramp", host="h1",
+                       delay_s=0.25, ramp_hits=10),
+        ),
+        slo_ms=400.0,
+        slo_floor=0.85,
+        recovery_tail=80,
+        defended_invariants=("probation_entered", "p99_recovery"),
+    )
+)
+
+_register(
+    Scenario(
+        name="preemption_storm",
+        description=(
+            "repeated host kills + respawns under idempotent traffic "
+            "(spot/preempted TPUs)"
+        ),
+        ticks=100,
+        tick_s=0.02,
+        health_every=2,
+        n_hosts=2,
+        n_replicas=2,
+        chips_per_replica=2,
+        streams=(Stream(base=2),),
+        fault_script=(
+            FaultEvent(at_tick=20, action="kill_host", host="h1"),
+            FaultEvent(at_tick=50, action="respawn_host", host="h1"),
+            FaultEvent(at_tick=75, action="kill_host", host="h2"),
+        ),
+        deadline_s=20.0,
+        slo_ms=2000.0,
+    )
+)
+
+_register(
+    Scenario(
+        name="diurnal_wave",
+        description=(
+            "sinusoidal load wave over remote replicas — capacity and "
+            "queue bounds under a compressed day"
+        ),
+        ticks=90,
+        tick_s=0.015,
+        n_hosts=2,
+        n_replicas=2,
+        chips_per_replica=2,
+        streams=(
+            Stream(kind="diurnal", base=1, amplitude=6, period=30),
+        ),
+        slo_ms=300.0,
+        slo_floor=0.9,
+        invariants=(
+            "zero_failed_idempotent",
+            "chip_accounting_exact",
+            "no_stuck_futures",
+            "bounded_queues",
+            "slo_attainment",
+        ),
+    )
+)
+
+_register(
+    Scenario(
+        name="blip_storm",
+        description=(
+            "repeated connection drops with warm rejoin — the control "
+            "plane flaps, traffic never notices"
+        ),
+        ticks=90,
+        tick_s=0.02,
+        health_every=3,
+        n_hosts=2,
+        n_replicas=2,
+        chips_per_replica=2,
+        streams=(Stream(base=2),),
+        fault_script=(
+            FaultEvent(at_tick=20, action="blip", host="h1"),
+            FaultEvent(at_tick=45, action="blip", host="h2"),
+            FaultEvent(at_tick=70, action="blip", host="h1"),
+        ),
+        deadline_s=20.0,
+        slo_ms=2000.0,
+    )
+)
+
+_register(
+    Scenario(
+        name="hot_signature",
+        description=(
+            "hot-key signature skew through the global scheduler — "
+            "coalescing keeps the hot signature batched"
+        ),
+        ticks=70,
+        tick_s=0.01,
+        n_hosts=0,
+        n_replicas=2,
+        max_ongoing=32,
+        service_s=0.006,
+        scheduling={"max_batch": 16, "max_wait_ms": 4.0},
+        streams=(
+            Stream(kind="burst", base=2, burst_every=5, burst_size=8,
+                   skew_keys=4),
+        ),
+        hedge=False,  # scheduler path owns placement; probation steers it
+        slo_ms=500.0,
+        invariants=(
+            "zero_failed_idempotent",
+            "no_stuck_futures",
+            "bounded_queues",
+            "coalescing_observed",
+        ),
+    )
+)
+
+_register(
+    Scenario(
+        name="tenant_flood",
+        description=(
+            "one tenant floods a scheduled deployment; quotas shed the "
+            "flood, the protected tenant never fails"
+        ),
+        ticks=80,
+        tick_s=0.01,
+        n_hosts=0,
+        n_replicas=2,
+        max_ongoing=8,
+        service_s=0.01,
+        scheduling={
+            # queue depth stays far above what the flood can pile up
+            # (tenant_quota is the shedding mechanism under test; a
+            # full queue would shed the PROTECTED tenant too)
+            "max_batch": 8,
+            "max_wait_ms": 2.0,
+            "max_queue_depth": 512,
+            "tenant_quota": 6,
+        },
+        streams=(
+            Stream(name="protected", tenant="alice", priority="interactive",
+                   base=2),
+            Stream(name="flood", tenant="mallory", priority="bulk",
+                   strict=False, base=0, kind="burst", burst_every=2,
+                   burst_size=24, start_tick=20, end_tick=60),
+        ),
+        hedge=False,
+        slo_ms=800.0,
+        invariants=(
+            "zero_failed_idempotent",
+            "no_stuck_futures",
+            "flood_shed_observed",
+        ),
+    )
+)
+
+
+def get_scenario(name: str) -> Scenario:
+    try:
+        return NAMED_SCENARIOS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown scenario '{name}' "
+            f"(known: {', '.join(sorted(NAMED_SCENARIOS))})"
+        ) from None
+
+
+def list_scenarios() -> list[dict]:
+    return [
+        {
+            "name": s.name,
+            "description": s.description,
+            "ticks": s.ticks,
+            "hosts": s.n_hosts,
+            "replicas": s.n_replicas,
+            "scheduled": s.scheduling is not None,
+            "faults": [
+                {"tick": ev.at_tick, "action": ev.action, "host": ev.host}
+                for ev in s.fault_script
+            ],
+            "invariants": list(s.invariants),
+            "defended_invariants": list(s.defended_invariants),
+        }
+        for s in NAMED_SCENARIOS.values()
+    ]
+
+
+__all__ = [
+    "FaultEvent",
+    "NAMED_SCENARIOS",
+    "Scenario",
+    "Stream",
+    "get_scenario",
+    "list_scenarios",
+    "outcome_signature",
+    "run_scenario",
+    "run_scenario_async",
+]

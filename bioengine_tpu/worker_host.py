@@ -509,7 +509,7 @@ class WorkerHost:
         from bioengine_tpu.serving.replica import Replica
 
         if faults.ACTIVE:
-            await faults.hit("host.start_replica")
+            await faults.hit("host.start_replica", scope=self.host_id)
 
         if mesh_shard is not None and not (
             self.connection is not None
@@ -604,7 +604,8 @@ class WorkerHost:
         expires, not just abandoned by the controller."""
         if faults.ACTIVE:
             await faults.hit(
-                "host.replica_call", drop=self._abort_connection
+                "host.replica_call", drop=self._abort_connection,
+                scope=self.host_id,
             )
         replica = self._get(replica_id)
         if method == "__batch__":

@@ -1,0 +1,519 @@
+"""Gray-failure defense: latency-outlier probation + request hedging.
+
+The failure mode under test is the one PR 4's breaker CANNOT see: a
+replica that still answers health checks while serving far slower than
+its siblings. Detection (EWMA vs deployment lower-median), the
+PROBATION state machine (soft-eject, trickle probe, self-correcting
+recovery), and request hedging (p95-delay second attempt, loser
+cancelled WITHOUT feeding the breaker or the EWMA) are pinned here;
+the end-to-end proof over real websockets lives in the scenario
+engine's ``slow_replica`` scenario (tests/test_scenarios.py).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from bioengine_tpu.cluster.state import ClusterState
+from bioengine_tpu.serving import (
+    DeploymentSpec,
+    OutlierConfig,
+    ReplicaState,
+    RequestOptions,
+    ServeController,
+)
+from bioengine_tpu.serving.outlier import DeploymentLatencyTracker
+from bioengine_tpu.utils import flight
+
+pytestmark = [pytest.mark.anyio]
+
+
+def make_tracker(**overrides) -> DeploymentLatencyTracker:
+    cfg = OutlierConfig(
+        enabled=True,
+        ewma_alpha=0.5,
+        ratio=3.0,
+        recovery_ratio=1.5,
+        excursion_s=0.5,
+        min_samples=4,
+        probe_every=4,
+        hedge_streak_limit=5,
+        **overrides,
+    )
+    return DeploymentLatencyTracker("app", "dep", cfg)
+
+
+class TestOutlierDetector:
+    def test_outlier_enters_probation_after_persistence(self):
+        t = make_tracker()
+        now = 100.0
+        # healthy baseline on three replicas
+        for i in range(6):
+            for rid in ("r1", "r2", "r3"):
+                t.note(rid, 0.01, now=now + i * 0.01)
+        now += 1.0
+        # r1 excursions: first over-threshold note STARTS the clock
+        assert t.note("r1", 0.2, now=now) == []
+        # still inside the persistence window: no verdict
+        assert t.note("r1", 0.2, now=now + 0.2) == []
+        # past excursion_s: probation
+        transitions = t.note("r1", 0.2, now=now + 0.6)
+        assert ("r1", "enter") in transitions
+        assert t.replicas["r1"].in_probation
+
+    def test_deployment_wide_shift_ejects_nobody(self):
+        """The adversarial case: a recompile / bigger batches slow the
+        WHOLE deployment together. Every EWMA rises, the median rises
+        with them — no replica is an outlier, nobody is ejected."""
+        t = make_tracker()
+        now = 100.0
+        for i in range(6):
+            for rid in ("r1", "r2", "r3"):
+                t.note(rid, 0.01, now=now + i * 0.01)
+        # everything shifts 20x at once, and stays there well past the
+        # persistence window
+        for i in range(20):
+            for rid in ("r1", "r2", "r3"):
+                assert t.note(rid, 0.2, now=now + 1.0 + i * 0.1) == []
+        assert not any(st.in_probation for st in t.replicas.values())
+
+    def test_recovery_needs_fresh_probe_samples(self):
+        """Exit requires measurements taken IN probation: the EWMA
+        frozen at entry (hedging dries up the sample stream) must not
+        exit the replica by itself."""
+        t = make_tracker()
+        now = 100.0
+        for i in range(6):
+            for rid in ("r1", "r2"):
+                t.note(rid, 0.01, now=now + i * 0.01)
+        for dt in (0.0, 0.2, 0.6):
+            t.note("r1", 0.12, now=now + 1.0 + dt)
+        assert t.replicas["r1"].in_probation
+        # one fast probe can never exit (the fresh-evidence gate needs
+        # two measurements taken IN probation) ...
+        assert ("r1", "exit") not in t.note("r1", 0.01, now=now + 2.0)
+        assert t.replicas["r1"].in_probation
+        # ... further fast probes decay the EWMA under recovery_ratio x
+        # median and the replica recovers on its own
+        exited_at = None
+        for i in range(8):
+            if ("r1", "exit") in t.note("r1", 0.01, now=now + 2.1 + i * 0.1):
+                exited_at = i
+                break
+        assert exited_at is not None, t.replicas["r1"]
+        assert not t.replicas["r1"].in_probation
+
+    def test_probation_is_a_minority_verdict(self):
+        """With max_eject_fraction=0.5 a 2-replica deployment ejects at
+        most one — the LAST healthy replica can never be soft-ejected
+        even when its latency looks awful."""
+        t = make_tracker()
+        now = 100.0
+        for i in range(6):
+            for rid in ("r1", "r2"):
+                t.note(rid, 0.01, now=now + i * 0.01)
+        for dt in (0.0, 0.6):
+            t.note("r1", 0.2, now=now + 1.0 + dt)
+        assert t.replicas["r1"].in_probation
+        # now r2 degrades too — the median is r1's... the verdict must
+        # NOT empty the routing set
+        for dt in (0.0, 0.3, 0.6, 0.9):
+            t.note("r2", 0.3, now=now + 2.0 + dt)
+        assert not t.replicas["r2"].in_probation
+
+    def test_hedge_loss_streak_enters_probation(self):
+        """Once hedging rescues every request off a gray replica, its
+        own samples stop (losers are cancelled, never measured) — the
+        consecutive hedge-loss streak is the detection path that still
+        works."""
+        t = make_tracker()
+        now = 100.0
+        for i in range(6):
+            for rid in ("r1", "r2", "r3"):
+                t.note(rid, 0.01, now=now + i * 0.01)
+        for _ in range(4):
+            assert ("r1", "enter") not in t.note_hedge_loss("r1", now=now)
+        transitions = t.note_hedge_loss("r1", now=now)
+        assert ("r1", "enter") in transitions
+        assert t.replicas["r1"].in_probation
+
+    def test_measured_completion_breaks_hedge_streak(self):
+        t = make_tracker()
+        now = 100.0
+        for i in range(6):
+            for rid in ("r1", "r2"):
+                t.note(rid, 0.01, now=now + i * 0.01)
+        for _ in range(4):
+            t.note_hedge_loss("r1", now=now)
+        t.note("r1", 0.01, now=now + 1.0)  # a real sample landed
+        assert t.replicas["r1"].hedge_streak == 0
+
+    def test_hedge_delay_is_p95_derived_with_override(self):
+        t = make_tracker()
+        for i in range(100):
+            t.note("r1", 0.010 if i % 20 else 0.050, now=100.0 + i)
+        delay = t.hedge_delay_s(now=300.0)
+        assert 0.010 < delay <= 0.050
+        fixed = DeploymentLatencyTracker(
+            "app", "dep", OutlierConfig(enabled=True, hedge_delay_s=0.123)
+        )
+        assert fixed.hedge_delay_s() == 0.123
+
+    def test_disabled_detector_never_transitions(self):
+        t = DeploymentLatencyTracker(
+            "app", "dep", OutlierConfig(enabled=False, min_samples=2)
+        )
+        for i in range(10):
+            t.note("r1", 0.01, now=100.0 + i)
+            assert t.note("r2", 1.0, now=100.0 + i) == []
+        assert t.note_hedge_loss("r2") == []
+
+
+# ---------------------------------------------------------------------------
+# controller-level probation state machine
+# ---------------------------------------------------------------------------
+
+
+class PaceableApp:
+    """Per-instance controllable service time — the gray knob."""
+
+    delays: dict = {}
+    cancelled: int = 0
+
+    def __init__(self):
+        self.tag = None
+        self.calls = 0
+
+    async def work(self, x=0):
+        self.calls += 1
+        try:
+            await asyncio.sleep(PaceableApp.delays.get(self.tag, 0.005))
+        except asyncio.CancelledError:
+            PaceableApp.cancelled += 1
+            raise
+        return {"x": x, "tag": self.tag}
+
+    async def check_health(self):
+        return "ok"  # gray failure: health always passes
+
+
+@pytest.fixture
+async def controller():
+    c = ServeController(
+        ClusterState(),
+        health_check_period=3600,
+        outlier_config=OutlierConfig(
+            enabled=True,
+            ewma_alpha=0.5,
+            ratio=2.5,
+            recovery_ratio=1.6,
+            excursion_s=0.15,
+            min_samples=4,
+            probe_every=4,
+            hedge_streak_limit=4,
+            hedge_delay_s=0.03,
+        ),
+    )
+    PaceableApp.delays = {}
+    PaceableApp.cancelled = 0
+    yield c
+    await c.stop()
+
+
+async def _deploy(controller, n=2, name="gf-app"):
+    app = await controller.deploy(
+        name,
+        [
+            DeploymentSpec(
+                name="e",
+                instance_factory=PaceableApp,
+                num_replicas=n,
+                autoscale=False,
+            )
+        ],
+    )
+    await asyncio.sleep(0.05)
+    for i, r in enumerate(app.replicas["e"]):
+        r.instance.tag = f"r{i}"
+    return app
+
+
+async def _drive(handle, n, options=None, x=0):
+    results = await asyncio.gather(
+        *(handle.call("work", x + i, options=options) for i in range(n)),
+        return_exceptions=True,
+    )
+    bad = [r for r in results if isinstance(r, BaseException)]
+    assert not bad, bad
+    return results
+
+
+class TestProbationStateMachine:
+    async def test_excursion_probation_probe_recovery(self, controller):
+        """The full loop: one replica turns gray → probation (flight
+        evidence, soft-ejected from the pick, trickle still probes) →
+        the instance heals → probes observe it → back to HEALTHY."""
+        app = await _deploy(controller, n=2)
+        r0, r1 = app.replicas["e"]
+        handle = controller.get_handle("gf-app")
+        opts = RequestOptions(idempotent=True)
+        t0 = time.time()
+        await _drive(handle, 12, opts)
+        assert r0.state == ReplicaState.HEALTHY
+
+        PaceableApp.delays = {r0.instance.tag: 0.1}  # r0 goes gray
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and r0.state != ReplicaState.PROBATION:
+            await _drive(handle, 4, opts)
+            await asyncio.sleep(0.02)
+        assert r0.state == ReplicaState.PROBATION
+        enters = [
+            e
+            for e in flight.get_events(
+                types=("replica.probation",), since=t0
+            )
+            if e["attrs"].get("phase") == "enter"
+        ]
+        assert enters and enters[0]["attrs"]["replica"] == r0.replica_id
+
+        # soft-ejected, still probed: under traffic the probation
+        # replica serves a trickle, the healthy one the bulk
+        base0 = r0.instance.calls
+        base1 = r1.instance.calls
+        await _drive(handle, 24, opts)
+        probes = r0.instance.calls - base0
+        assert probes >= 1, "trickle probe never reached the gray replica"
+        assert r1.instance.calls - base1 > probes
+
+        # health checks pass throughout and must NOT clear probation
+        assert await r0.check_health() == ReplicaState.PROBATION
+
+        PaceableApp.delays = {}  # the replica heals
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and r0.state != ReplicaState.HEALTHY:
+            await _drive(handle, 6, opts)
+            await asyncio.sleep(0.02)
+        assert r0.state == ReplicaState.HEALTHY
+        exits = [
+            e
+            for e in flight.get_events(
+                types=("replica.probation",), since=t0
+            )
+            if e["attrs"].get("phase") == "exit"
+        ]
+        assert exits and exits[-1]["attrs"]["replica"] == r0.replica_id
+
+    async def test_deployment_wide_slowdown_no_ejection(self, controller):
+        """Recompile / bigger batches: EVERY replica slows together —
+        the median moves with them and nobody enters probation."""
+        app = await _deploy(controller, n=2, name="gf-app2")
+        handle = controller.get_handle("gf-app2")
+        opts = RequestOptions(idempotent=True)
+        await _drive(handle, 12, opts)
+        PaceableApp.delays = {"r0": 0.08, "r1": 0.08}
+        for _ in range(6):
+            await _drive(handle, 6, opts)
+            await asyncio.sleep(0.02)
+        assert all(
+            r.state == ReplicaState.HEALTHY for r in app.replicas["e"]
+        )
+
+    async def test_undeploy_sweeps_outlier_tracker(self, controller):
+        await _deploy(controller, n=2, name="gf-app3")
+        handle = controller.get_handle("gf-app3")
+        await _drive(handle, 4, RequestOptions(idempotent=True))
+        assert ("gf-app3", "e") in controller._outliers
+        await controller.undeploy("gf-app3")
+        assert ("gf-app3", "e") not in controller._outliers
+        assert controller._queue_depth == {}
+
+    async def test_probation_surfaces_in_app_status(self, controller):
+        await _deploy(controller, n=2, name="gf-app4")
+        handle = controller.get_handle("gf-app4")
+        await _drive(handle, 8, RequestOptions(idempotent=True))
+        status = controller.get_app_status("gf-app4")
+        gray = status["deployments"]["e"]["gray_failure"]
+        assert gray["enabled"] is True
+        assert gray["replicas"]
+        for info in gray["replicas"].values():
+            assert "ewma_s" in info and "in_probation" in info
+
+
+# ---------------------------------------------------------------------------
+# request hedging
+# ---------------------------------------------------------------------------
+
+
+class TestHedging:
+    async def test_hedge_requires_idempotent(self):
+        with pytest.raises(ValueError, match="idempotent"):
+            RequestOptions(hedge=True, idempotent=False)
+
+    async def test_hedge_rescues_slow_primary(self, controller):
+        """The tail defense: primary stuck at 0.5s, hedge fires after
+        the fixed 30ms delay, a sibling answers fast, first result
+        wins. The loser is cancelled and feeds NEITHER the breaker NOR
+        the outlier EWMA — the satellite regression pin."""
+        app = await _deploy(controller, n=2, name="hg-app")
+        r0, r1 = app.replicas["e"]
+        # make round-robin deterministic: force the pick to r0 first by
+        # loading r1... simpler: slow BOTH directions and accept either
+        # primary — the winner must be the fast sibling either way
+        PaceableApp.delays = {r0.instance.tag: 0.5}
+        handle = controller.get_handle("hg-app")
+        opts = RequestOptions(idempotent=True, hedge=True)
+        t0 = time.time()
+        tracker = controller._outlier_tracker("hg-app", "e")
+        samples_before = {
+            rid: tracker.sample_count(rid)
+            for rid in (r0.replica_id, r1.replica_id)
+        }
+        cancelled_before = PaceableApp.cancelled
+        # several calls: whichever replica the router picks first, any
+        # call landing on r0 is rescued by its hedge within ~50ms
+        t_start = time.monotonic()
+        results = await _drive(handle, 6, opts)
+        wall = time.monotonic() - t_start
+        assert wall < 0.4, f"hedges did not rescue the tail ({wall:.3f}s)"
+        assert all(r["tag"] == r1.instance.tag for r in results)
+
+        hedge_events = flight.get_events(types=("request.hedge",), since=t0)
+        wins = [e for e in hedge_events if e["attrs"]["winner"] == "hedge"]
+        assert wins, hedge_events
+        # cancelled losers: the slow instance observed cancellations...
+        await asyncio.sleep(0.05)
+        assert PaceableApp.cancelled > cancelled_before
+        # ...which fed NEITHER the breaker NOR the outlier EWMA
+        assert controller._breaker_counts.get(r0.replica_id) is None
+        assert (
+            tracker.sample_count(r0.replica_id)
+            == samples_before[r0.replica_id]
+        )
+        # and the semaphore/ongoing accounting is exact (no leak)
+        for r in (r0, r1):
+            assert r._ongoing == 0
+            assert r._queued == 0
+            assert r._semaphore._value == r.max_ongoing_requests
+
+    async def test_hedge_attempts_are_trace_siblings(
+        self, controller, monkeypatch
+    ):
+        from bioengine_tpu.utils import tracing
+
+        monkeypatch.setenv("BIOENGINE_TRACE_SAMPLE", "1.0")
+        tracing.reset_env_cache()
+        try:
+            app = await _deploy(controller, n=2, name="hg-tr")
+            r0, r1 = app.replicas["e"]
+            PaceableApp.delays = {
+                r0.instance.tag: 0.4,
+                r1.instance.tag: 0.4,
+            }
+            # both slow → the hedge definitely launches; then free the
+            # second replica so the hedge wins decisively
+            handle = controller.get_handle("hg-tr")
+
+            async def call():
+                return await handle.call(
+                    "work", 1,
+                    options=RequestOptions(idempotent=True, hedge=True),
+                )
+
+            task = asyncio.create_task(call())
+            await asyncio.sleep(0.06)  # hedge armed by now
+            PaceableApp.delays = {}
+            await asyncio.wait_for(task, 3)
+            spans = tracing.get_spans(max_spans=400)
+            attempts = [s for s in spans if s["name"] == "attempt"]
+            hedged = [
+                s for s in attempts if s["attrs"].get("hedge") is not None
+            ]
+            assert len(hedged) >= 2, attempts
+            trace_ids = {s["trace_id"] for s in hedged[-2:]}
+            assert len(trace_ids) == 1  # siblings under ONE trace
+            labels = {s["attrs"]["hedge"] for s in hedged[-2:]}
+            assert labels == {"primary", "hedge"}
+        finally:
+            monkeypatch.delenv("BIOENGINE_TRACE_SAMPLE", raising=False)
+            tracing.reset_env_cache()
+
+    async def test_single_replica_hedge_degrades_gracefully(
+        self, controller
+    ):
+        await _deploy(controller, n=1, name="hg-one")
+        handle = controller.get_handle("hg-one")
+        t0 = time.time()
+        result = await handle.call(
+            "work", 5, options=RequestOptions(idempotent=True, hedge=True)
+        )
+        assert result["x"] == 5
+        # nobody to hedge on → no hedge event, no error
+        assert flight.get_events(types=("request.hedge",), since=t0) == []
+
+    async def test_hedged_app_error_never_feeds_breaker(self, controller):
+        """Same breaker contract as every other dispatch path: a
+        deterministic APPLICATION error riding a hedged attempt (bad
+        client input) must never strike a healthy replica."""
+
+        class BuggyApp:
+            async def work(self, x=0):
+                raise ValueError("bad input")
+
+        await controller.deploy(
+            "hg-buggy",
+            [
+                DeploymentSpec(
+                    name="e",
+                    instance_factory=BuggyApp,
+                    num_replicas=2,
+                    autoscale=False,
+                )
+            ],
+        )
+        await asyncio.sleep(0.05)
+        handle = controller.get_handle("hg-buggy")
+        for _ in range(controller.breaker_threshold + 1):
+            with pytest.raises(ValueError, match="bad input"):
+                await handle.call(
+                    "work",
+                    options=RequestOptions(idempotent=True, hedge=True),
+                )
+        assert controller._breaker_counts == {}
+        app = controller.apps["hg-buggy"]
+        assert all(
+            r.state == ReplicaState.HEALTHY for r in app.replicas["e"]
+        )
+
+    async def test_hedged_failure_still_fails_over(self, controller):
+        """When the primary genuinely dies (transport), the hedged
+        attempt path surfaces the same typed behavior the plain path
+        would — and the outer retry loop still fails over."""
+
+        class FlakyApp:
+            failures = 0
+
+            async def work(self, x=0):
+                if FlakyApp.failures < 1:
+                    FlakyApp.failures += 1
+                    raise ConnectionError("synthetic transport failure")
+                return {"x": x}
+
+        FlakyApp.failures = 0
+        await controller.deploy(
+            "hg-flaky",
+            [
+                DeploymentSpec(
+                    name="e",
+                    instance_factory=FlakyApp,
+                    num_replicas=2,
+                    autoscale=False,
+                )
+            ],
+        )
+        await asyncio.sleep(0.05)
+        handle = controller.get_handle("hg-flaky")
+        result = await handle.call(
+            "work", 3, options=RequestOptions(idempotent=True, hedge=True)
+        )
+        assert result["x"] == 3
