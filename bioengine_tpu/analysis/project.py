@@ -510,11 +510,17 @@ class _Indexer(ast.NodeVisitor):
             if key and key.startswith("BIOENGINE_"):
                 self.env_reads.append([key, line, col])
 
-        # capability gates through the negotiation helper
+        # capability gates through the negotiation helpers: the
+        # client-side ``peer_supports(TOKEN)`` and the server-side
+        # ``service_peer_supports(service_id, TOKEN)`` (the controller
+        # gating a verb on what a ws host declared at its handshake)
+        token = None
         if leaf == "peer_supports" and node.args:
             token = self._cap_token(node.args[0])
-            if token:
-                self.caps_gated.append([token, line, col])
+        elif leaf == "service_peer_supports" and len(node.args) >= 2:
+            token = self._cap_token(node.args[1])
+        if token:
+            self.caps_gated.append([token, line, col])
 
         self.generic_visit(node)
 

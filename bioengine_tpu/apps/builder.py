@@ -408,7 +408,9 @@ class AppBuilder:
                 # deployment named — never a raw traceback
                 raise AppBuildError(
                     f"invalid mesh/batching/scheduling/warm_pool/slo "
-                    f"config for deployment '{ref.file_stem}': {e}"
+                    f"config for deployment '{ref.file_stem}': {e} "
+                    f"(vocabulary reference: docs/apps-guide.md, "
+                    f"'The deployment_config vocabulary')"
                 ) from e
             specs.append(
                 DeploymentSpec(

@@ -250,10 +250,20 @@ class AppsManager:
                 ),
                 deployer=deployer,
             )
-            self._check_resources(built)
-            await self.controller.deploy(
+            # journal recovery may have resurrected the controller half
+            # of this app already (worker restart with a control dir:
+            # the journal AND the manager's record file cover the same
+            # apps) — re-attach the build to the recovered intent
+            # instead of colliding with an "already deployed" error.
+            # The resource pre-check is skipped on that path: adopted
+            # replicas already hold their chips.
+            if not self.controller.adopt_recovered_specs(
                 app_id, built.specs, acl=built.authorized_users
-            )
+            ):
+                self._check_resources(built)
+                await self.controller.deploy(
+                    app_id, built.specs, acl=built.authorized_users
+                )
             proxy = AppServiceProxy(self.server, self.controller, built)
             proxy.register()
             frontend_url = self._register_frontend(app_id, built)

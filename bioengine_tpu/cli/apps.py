@@ -192,6 +192,27 @@ def _mesh_lines(status: dict) -> list[str]:
     return lines
 
 
+def _controller_line(status: dict) -> str:
+    """The durable-control-plane header: fencing epoch + phase, and —
+    while a restarted controller reconciles — the adopt/replace/drop
+    counters an operator watches converge."""
+    apps = status if "deployments" not in status else {"": status}
+    for st in apps.values():
+        ctl = (st or {}).get("controller")
+        if not ctl:
+            continue
+        line = f"controller: epoch={ctl.get('epoch')} phase={ctl.get('phase')}"
+        rec = ctl.get("reconcile")
+        if rec and ctl.get("phase") == "RECOVERING":
+            line += (
+                f" (reconciling: adopted={rec.get('adopted', 0)} "
+                f"replaced={rec.get('replaced', 0)} "
+                f"dropped={rec.get('dropped', 0)})"
+            )
+        return line
+    return ""
+
+
 @apps_group.command("status")
 @click.argument("app_id", required=False)
 @server_options
@@ -202,11 +223,14 @@ def status_command(app_id, server_url, token):
     )
     cold = _cold_start_lines(result if isinstance(result, dict) else {})
     mesh = _mesh_lines(result if isinstance(result, dict) else {})
+    ctl = _controller_line(result if isinstance(result, dict) else {})
     human = json.dumps(result, indent=2, default=str)
     if mesh:
         human = "mesh:\n" + "\n".join(mesh) + "\n\n" + human
     if cold:
         human = "cold-start:\n" + "\n".join(cold) + "\n\n" + human
+    if ctl:
+        human = ctl + "\n\n" + human
     emit(result, human=human)
 
 

@@ -68,6 +68,66 @@ def bundle_command(server_url, token, output, event_limit):
     )
 
 
+@debug_group.command("journal")
+@click.option(
+    "--dir",
+    "control_dir",
+    default=None,
+    help="Control-plane journal directory "
+    "(default: $BIOENGINE_CONTROL_DIR)",
+)
+@click.option(
+    "--tail", default=20, show_default=True,
+    help="Journal records to show (newest last)",
+)
+def journal_command(control_dir, tail):
+    """Inspect the controller's durable state OFFLINE: the compacted
+    snapshot plus the journal tail (secrets redacted) — the first
+    thing the 'Controller loss & upgrade' runbook reads after the
+    epoch. Works against a dead controller's directory; no server
+    needed."""
+    import os
+
+    from bioengine_tpu.serving.journal import ControlJournal
+
+    directory = control_dir or os.environ.get("BIOENGINE_CONTROL_DIR")
+    if not directory:
+        raise click.UsageError(
+            "no journal directory: pass --dir or set BIOENGINE_CONTROL_DIR"
+        )
+    if not Path(directory).expanduser().exists():
+        raise click.UsageError(f"journal directory not found: {directory}")
+    info = ControlJournal(directory).inspect(tail=tail)
+    snap = info.get("snapshot") or {}
+    lines = [
+        f"directory: {info['directory']}",
+        f"snapshot: epoch={snap.get('epoch', '-')} "
+        f"seq={snap.get('seq', '-')} apps={len(snap.get('apps') or {})} "
+        f"recovering={snap.get('recovering', False)}"
+        if snap
+        else "snapshot: (none)",
+        f"journal: {info['journal_records']} record(s)"
+        + (" — TORN TAIL (truncated final record discarded)"
+           if info["torn_tail"] else ""),
+    ]
+    for app_id, entry in (snap.get("apps") or {}).items():
+        deps = ", ".join(
+            f"{s.get('name')}x{s.get('num_replicas')}"
+            for s in entry.get("specs", [])
+        )
+        lines.append(f"  app {app_id}: {deps}")
+    if info["tail"]:
+        lines.append(f"tail (last {len(info['tail'])}):")
+        for r in info["tail"]:
+            lines.append(
+                f"  #{r.get('seq')} "
+                f"{time.strftime('%H:%M:%S', time.localtime(r.get('ts', 0)))} "
+                f"epoch={r.get('epoch')} {r.get('op')} "
+                + json.dumps(r.get("data") or {}, default=str)[:160]
+            )
+    emit(info, human="\n".join(lines))
+
+
 @debug_group.command("flight")
 @server_options
 @click.option("--limit", default=50, show_default=True)

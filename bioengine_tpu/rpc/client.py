@@ -82,6 +82,7 @@ class ServerConnection:
                 protocol.PROTO_TRACE1,
                 protocol.PROTO_TELEM1,
                 protocol.PROTO_MESH1,
+                protocol.PROTO_EPOCH1,
             ]
             if protocols is None
             else list(protocols)
@@ -90,6 +91,10 @@ class ServerConnection:
         # future server-side capabilities gate on this, see
         # peer_supports) and the last measured wall-clock offset to it
         self.peer_protocols: list[str] = []
+        # the controller fencing epoch the server's welcome advertised
+        # (None on legacy / non-controller servers) — worker hosts use
+        # it to refuse rejoining a stale revived controller
+        self.peer_epoch: Optional[int] = None
         self.clock_offset_s: Optional[float] = None
         self.clock_offset_rtt_s: Optional[float] = None
         self.auto_reconnect = auto_reconnect
@@ -142,6 +147,7 @@ class ServerConnection:
         self.workspace = welcome["workspace"]
         self.user_id = welcome["user_id"]
         self.peer_protocols = list(welcome.get("protocols", []))
+        self.peer_epoch = welcome.get("epoch")
         self.codec.oob = protocol.PROTO_OOB1 in self.protocols and (
             protocol.PROTO_OOB1 in welcome.get("protocols", [])
         )

@@ -71,6 +71,9 @@ PROTO_TRACE1 = "trace1"        # request-trace fields on CALL/RESULT
 PROTO_TELEM1 = "telem1"        # push-telemetry verbs on the serve-router
 PROTO_MESH1 = "mesh1"          # cross-host mesh shards (mesh_shard on
                                # start_replica, stage activations over OOB)
+PROTO_EPOCH1 = "epoch1"        # controller-epoch fencing: epoch kwarg on
+                               # placement/lifecycle verbs, rejected typed
+                               # when stale (StaleEpochError)
 
 EXT_NDARRAY = 1                # legacy inline array (double-packed)
 EXT_EXCEPTION = 2

@@ -130,6 +130,10 @@ class RpcServer:
         self._shm_store_cfg = shm_store
         self._shm_store: Any = None
         self._shm_nonces: dict[str, tuple[str, bytes]] = {}  # client -> (key, nonce)
+        # controller fencing epoch (set by ServeController.attach_rpc):
+        # advertised in the welcome so a connecting host can spot a
+        # stale (wedged-then-revived) controller before any verbs flow
+        self.epoch: Optional[int] = None
 
     # ---- lifecycle ----------------------------------------------------------
 
@@ -644,8 +648,11 @@ class RpcServer:
                 protocol.PROTO_TRACE1,
                 protocol.PROTO_TELEM1,
                 protocol.PROTO_MESH1,
+                protocol.PROTO_EPOCH1,
             ],
         }
+        if self.epoch is not None:
+            welcome["epoch"] = self.epoch
         if codec.oob and self._shm_store is not None:
             # same-host probe: the client must read this nonce OUT OF
             # the segment and echo it back — proof the two processes

@@ -12,7 +12,9 @@ from bioengine_tpu.serving.errors import (
     NoHealthyReplicasError,
     ReplicaUnavailableError,
     RetryableTransportError,
+    StaleEpochError,
 )
+from bioengine_tpu.serving.journal import ControlJournal, JournalState
 from bioengine_tpu.serving.mesh_plan import (
     MeshConfig,
     MeshPlan,
@@ -39,6 +41,7 @@ __all__ = [
     "AdmissionRejectedError",
     "ApplicationError",
     "ContinuousBatcher",
+    "ControlJournal",
     "CrossHostEngine",
     "DeadlineExceeded",
     "DeploymentHandle",
@@ -46,6 +49,7 @@ __all__ = [
     "DeploymentScheduler",
     "DeploymentSpec",
     "HeuristicCostModel",
+    "JournalState",
     "LoadPredictor",
     "MeshConfig",
     "MeshPlan",
@@ -61,6 +65,7 @@ __all__ = [
     "RetryableTransportError",
     "SchedulingConfig",
     "SLOConfig",
+    "StaleEpochError",
     "SLOEngine",
     "ServeController",
     "CompileCacheTier",

@@ -26,7 +26,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from bioengine_tpu.cluster.state import ClusterState
-from bioengine_tpu.rpc.protocol import PROTO_MESH1, RemoteError
+from bioengine_tpu.rpc.protocol import PROTO_EPOCH1, PROTO_MESH1, RemoteError
 from bioengine_tpu.serving.errors import (
     AdmissionRejectedError,
     DeadlineExceeded,
@@ -64,6 +64,11 @@ from bioengine_tpu.serving.replica import (
 )
 from bioengine_tpu.serving.slo import SLOConfig, SLOEngine
 from bioengine_tpu.serving.compile_tier import CompileCacheTier
+from bioengine_tpu.serving.journal import (
+    ControlJournal,
+    spec_from_dict,
+    spec_to_dict,
+)
 from bioengine_tpu.serving.warm_pool import WarmPool, WarmPoolConfig
 from bioengine_tpu.utils import flight, metrics, tracing
 from bioengine_tpu.utils.tasks import spawn_supervised
@@ -107,6 +112,31 @@ REQUEST_HEDGES = metrics.counter(
     "request_hedges_total",
     "hedge attempts launched for idempotent calls, by winning attempt",
     ("app", "deployment", "winner"),
+)
+# durable control plane (serving/journal.py): the fencing epoch this
+# process serves under, and what the recovery reconcile did
+CONTROLLER_EPOCH = metrics.gauge(
+    "controller_epoch",
+    "monotonic fencing epoch minted at controller start (journaled)",
+)
+RECONCILE_ADOPTED = metrics.counter(
+    "reconcile_adopted_total",
+    "replicas re-adopted in place from host inventory at recovery",
+)
+RECONCILE_REPLACED = metrics.counter(
+    "reconcile_replaced_total",
+    "replicas re-placed from journaled intent at recovery settle",
+)
+RECONCILE_DROPPED = metrics.counter(
+    "reconcile_dropped_total",
+    "host-reported replicas dropped at recovery (no matching intent)",
+)
+
+# host verbs that carry the controller epoch so hosts can fence a
+# wedged-then-revived old controller (register_host carries it in its
+# RESULT instead — the host learns the epoch there)
+_EPOCH_STAMPED_VERBS = frozenset(
+    {"start_replica", "drain_replica", "stop_replica"}
 )
 
 
@@ -908,6 +938,7 @@ class ServeController:
         breaker_threshold: Optional[int] = None,
         health_check_concurrency: int = 8,
         outlier_config: Optional[OutlierConfig] = None,
+        control_dir: Optional[str] = None,
     ):
         self.cluster_state = cluster_state or ClusterState()
         self.health_check_period = health_check_period
@@ -969,6 +1000,45 @@ class ServeController:
         self._telemetry_task: Optional[asyncio.Task] = None
         self.slo_bundles: deque = deque(maxlen=4)   # auto-captured artifacts
         self._slo_bundle_last: dict[tuple[str, str], float] = {}
+        # ---- durable control plane (serving/journal.py) -----------------
+        # intent journal + snapshot under control_dir /
+        # BIOENGINE_CONTROL_DIR; None = memory-only (exactly the old
+        # behavior). Every start MINTS a persisted monotonic epoch —
+        # the fence hosts use to reject verbs from a revived old
+        # controller — whether or not recover() is ever called.
+        self.journal = (
+            ControlJournal(control_dir)
+            if control_dir
+            else ControlJournal.from_env()
+        )
+        self._journal_state = None
+        self.phase = "ACTIVE"              # ACTIVE | RECOVERING
+        self.reconcile_report: Optional[dict] = None
+        self._recover_deadline: Optional[float] = None
+        # mesh shards reported by rejoining hosts, keyed by the mesh
+        # replica id they belong to — a MeshReplica is rebuilt once
+        # every stage has reported (serving/journal.py module docstring)
+        self._pending_mesh_shards: dict[str, dict[int, dict]] = {}
+        # complete-but-surplus meshes (intent already satisfied when
+        # the last stage reported): their earlier stages were answered
+        # "kept" before the surplus was knowable, so the settle sweep
+        # must stop them host-side
+        self._surplus_mesh_shards: dict[str, dict[int, dict]] = {}
+        self.reconcile_grace_s = float(
+            os.environ.get("BIOENGINE_RECONCILE_GRACE_S", "20")
+        )
+        if self.journal is not None:
+            self._journal_state = self.journal.load()
+            self.journal.snapshot_provider = self._journal_snapshot_state
+            self.epoch = self.journal.mint_epoch()
+        else:
+            self.epoch = 1
+        CONTROLLER_EPOCH.set(self.epoch)
+        flight.record(
+            "controller.epoch",
+            epoch=self.epoch,
+            journaled=self.journal is not None,
+        )
         _CONTROLLERS.add(self)             # scrape-time serving gauges
 
     # ---- multi-host control plane -------------------------------------------
@@ -987,6 +1057,21 @@ class ServeController:
 
         self._rpc_server = server
         self._router_admins = list(admin_users or [])
+        if not self._router_admins and self._journal_state is not None:
+            # a restarted controller attached without explicit admins
+            # restores the journaled bindings (worker restarts normally
+            # pass their own list, which then re-journals below)
+            self._router_admins = list(self._journal_state.admins)
+        # the welcome handshake advertises the fencing epoch so a host
+        # can spot a stale controller before exchanging any verbs
+        server.epoch = self.epoch
+        if self.journal is not None and self._router_admins:
+            # via _journal_append: a full/readonly disk degrades
+            # durability, never controller attach, and the folded
+            # snapshot view keeps the RECOVERING flag accurate
+            self._journal_append(
+                "admins", {"admins": list(self._router_admins)}
+            )
 
         async def route_call(
             app_id, deployment, method, args=None, kwargs=None, context=None
@@ -1022,8 +1107,21 @@ class ServeController:
             # re-placed elsewhere is returned for the host to discard
             drop_replicas = []
             for info in replicas or []:
-                if not self._readopt_replica(host_id, service_id, info):
-                    drop_replicas.append(info.get("replica_id"))
+                # two reconciliation paths: a warm replica the routing
+                # set still knows (blip rejoin) is re-adopted in place;
+                # during RECOVERY the routing set is empty, so a replica
+                # matching journaled intent is adopted from the report
+                # instead. Anything matching neither is dropped — the
+                # journal is the intent of record.
+                if self._readopt_replica(host_id, service_id, info):
+                    continue
+                if self._adopt_reported_replica(host_id, service_id, info):
+                    continue
+                if self.phase == "RECOVERING":
+                    RECONCILE_DROPPED.inc()
+                    if self.reconcile_report is not None:
+                        self.reconcile_report["dropped"] += 1
+                drop_replicas.append(info.get("replica_id"))
             self.logger.info(
                 f"host '{host_id}' joined with "
                 f"{topology.get('n_chips', 0)} chips ({service_id})"
@@ -1048,6 +1146,9 @@ class ServeController:
                 "host_id": host_id,
                 "registered": True,
                 "drop_replicas": drop_replicas,
+                # the fencing epoch: the host records it and rejects
+                # replica verbs stamped with anything lower
+                "epoch": self.epoch,
             }
 
         def deregister_host(host_id, context=None):
@@ -1133,6 +1234,16 @@ class ServeController:
     ):
         if self._rpc_server is None:
             raise RuntimeError("controller has no RPC server attached")
+        if method in _EPOCH_STAMPED_VERBS and self._rpc_server.service_peer_supports(
+            service_id, PROTO_EPOCH1
+        ):
+            # every placement/lifecycle verb carries this controller's
+            # epoch; a host that has seen a newer one rejects it typed
+            # (StaleEpochError) — the split-brain fence. A pre-epoch1
+            # host never declared the capability, so it gets the legacy
+            # signature (and no fence) instead of an unexpected-kwarg
+            # TypeError on every placement
+            kwargs.setdefault("epoch", self.epoch)
         return await self._rpc_server.call_service_method(
             service_id, method, args, kwargs,
             **({"timeout": rpc_timeout} if rpc_timeout else {}),
@@ -1174,7 +1285,10 @@ class ServeController:
         snapshot = self._telem_sampler.sample()
         if snapshot:
             self.telemetry.ingest(snapshot, host_id="controller")
-        if self.slo.deployments():
+        # SLO verdicts are deferred while RECOVERING: burn rates
+        # computed over a half-seen cluster would fire (and feed scale
+        # pressure) on recovery noise, not service behavior
+        if self.slo.deployments() and self.phase != "RECOVERING":
             self.slo.evaluate()
 
     # ---- deploy / undeploy --------------------------------------------------
@@ -1192,32 +1306,22 @@ class ServeController:
             app_id=app_id, specs={s.name: s for s in specs}, acl=acl
         )
         self.apps[app_id] = app
+        # intent commit: the deploy is ACCEPTED (validated specs, app
+        # registered) — journal it now so a crash mid-placement recovers
+        # to "place this app", never to silence. Placement failures roll
+        # the record back below.
+        self._journal_append(
+            "deploy",
+            {
+                "app_id": app_id,
+                "specs": [spec_to_dict(s) for s in specs],
+                "acl": acl,
+            },
+        )
         try:
             for spec in specs:
                 app.replicas[spec.name] = []
-                if spec.scheduling is not None and spec.scheduling.enabled:
-                    scheduler = DeploymentScheduler(
-                        self,
-                        app_id,
-                        spec.name,
-                        spec,
-                        spec.scheduling,
-                        scorer=self.scorer_factory(),
-                    )
-                    self._schedulers[(app_id, spec.name)] = scheduler
-                    if spec.scheduling.slo_pressure and spec.slo is not None:
-                        # close the loop: the predictive autoscaler may
-                        # consume budget burn as an up-pressure signal
-                        # (opt-in — scheduling.slo_pressure)
-                        scheduler.pressure_fn = (
-                            lambda a=app_id, d=spec.name: self.slo.burn_pressure(a, d)
-                        )
-                if spec.slo is not None:
-                    self.slo.register(app_id, spec.name, spec.slo)
-                if spec.warm_pool is not None and spec.warm_pool.size > 0:
-                    self._warm_pools[(app_id, spec.name)] = WarmPool(
-                        app_id, spec.name, spec.warm_pool
-                    )
+                self._init_deployment_plumbing(app_id, spec)
                 for _ in range(spec.num_replicas):
                     await self._add_replica(app, spec)
             # pools fill AFTER every serving replica is placed — a tight
@@ -1231,6 +1335,9 @@ class ServeController:
             # Roll back partial state: stop started replicas and release
             # their chip leases so a failed deploy leaks nothing.
             app.status = "DEPLOY_FAILED"
+            # the intent did not commit — a recovering controller must
+            # not resurrect a deploy that never finished
+            self._journal_append("undeploy", {"app_id": app_id})
             self.slo.unregister(app_id)
             for spec in specs:
                 sched = self._schedulers.pop((app_id, spec.name), None)
@@ -1251,6 +1358,508 @@ class ServeController:
                         self.cluster_state.mark_replica_dead(r.replica_id)
             raise
         return app
+
+    def _init_deployment_plumbing(self, app_id: str, spec: DeploymentSpec) -> None:
+        """Per-deployment controller plumbing shared by ``deploy`` and
+        journal recovery: the opt-in global scheduler, SLO tracking,
+        and the warm pool shell (pools FILL later — after serving
+        replicas, or after reconcile settles)."""
+        if spec.scheduling is not None and spec.scheduling.enabled:
+            scheduler = DeploymentScheduler(
+                self,
+                app_id,
+                spec.name,
+                spec,
+                spec.scheduling,
+                scorer=self.scorer_factory(),
+            )
+            self._schedulers[(app_id, spec.name)] = scheduler
+            if spec.scheduling.slo_pressure and spec.slo is not None:
+                # close the loop: the predictive autoscaler may
+                # consume budget burn as an up-pressure signal
+                # (opt-in — scheduling.slo_pressure)
+                scheduler.pressure_fn = (
+                    lambda a=app_id, d=spec.name: self.slo.burn_pressure(a, d)
+                )
+        if spec.slo is not None:
+            self.slo.register(app_id, spec.name, spec.slo)
+        if spec.warm_pool is not None and spec.warm_pool.size > 0:
+            self._warm_pools[(app_id, spec.name)] = WarmPool(
+                app_id, spec.name, spec.warm_pool
+            )
+
+    # ---- durable control plane: journal + crash recovery --------------------
+
+    def _journal_snapshot_state(self) -> tuple:
+        """Lazy snapshot provider: the journal pulls the folded intent
+        only when a compaction actually fires (1-in-snapshot_every
+        appends, plus the explicit recover/settle snapshots) — a plain
+        append never pays the full-fleet spec serialization."""
+        apps = {
+            app_id: {
+                "specs": [spec_to_dict(s) for s in app.specs.values()],
+                "acl": app.acl,
+            }
+            for app_id, app in self.apps.items()
+            if app.status not in ("STOPPED", "DEPLOY_FAILED")
+        }
+        return apps, self._router_admins, self.phase == "RECOVERING"
+
+    def _journal_append(self, op: str, data: dict) -> None:
+        if self.journal is None:
+            return
+        try:
+            self.journal.append(op, data)
+        except OSError as e:
+            # a full/readonly disk must degrade durability, not serving
+            self.logger.error(f"journal append failed ({op}): {e}")
+
+    async def recover(self) -> dict:
+        """Rebuild declarative intent from snapshot + journal into a
+        ``RECOVERING`` phase: apps exist with their full specs (so
+        routing, handles and new deploys work) but with EMPTY replica
+        sets. Live hosts rejoin with their warm-replica inventory and
+        :meth:`_adopt_reported_replica` re-adopts matching replicas in
+        place; after ``BIOENGINE_RECONCILE_GRACE_S`` (or once every
+        intent is satisfied) the health loop settles the diff —
+        re-placing only what no host still serves — and flips the
+        phase to ``ACTIVE``. Until then autoscale and SLO verdicts are
+        DEFERRED: a half-seen cluster must not be "scaled down"."""
+        if self.journal is None:
+            raise RuntimeError(
+                "recovery needs a control journal "
+                "(control_dir= or BIOENGINE_CONTROL_DIR)"
+            )
+        state = self._journal_state or self.journal.load()
+        report = {
+            "epoch": self.epoch,
+            "apps": 0,
+            "adopted": 0,
+            "replaced": 0,
+            "dropped": 0,
+            "mesh_rebuilt": 0,
+            "torn_tail": state.torn_tail,
+            "started_at": time.time(),
+            "settled_at": None,
+        }
+        self.reconcile_report = report
+        self._recover_started_mono = time.monotonic()
+        if state.admins and not self._router_admins:
+            self._router_admins = list(state.admins)
+        for app_id, entry in state.apps.items():
+            if app_id in self.apps:
+                continue  # double recover() is a no-op per app
+            specs = [
+                spec_from_dict(
+                    sd,
+                    app_id,
+                    make_handle=lambda name, a=app_id: self.get_handle(
+                        a, name
+                    ),
+                )
+                for sd in entry.get("specs", [])
+            ]
+            app = AppDeployment(
+                app_id=app_id,
+                specs={s.name: s for s in specs},
+                acl=entry.get("acl"),
+            )
+            app.status = "RECOVERING"
+            self.apps[app_id] = app
+            for spec in specs:
+                app.replicas[spec.name] = []
+                self._init_deployment_plumbing(app_id, spec)
+            report["apps"] += 1
+        if report["apps"]:
+            self.phase = "RECOVERING"
+            self._recover_deadline = (
+                time.monotonic() + self.reconcile_grace_s
+            )
+            self._wake_health.set()
+        flight.record(
+            "controller.recovering",
+            severity="warning",
+            epoch=self.epoch,
+            apps=report["apps"],
+            records_replayed=state.records_replayed,
+            torn_tail=state.torn_tail,
+            snapshot=state.snapshot_loaded,
+        )
+        self.logger.info(
+            f"recovered intent for {report['apps']} app(s) from "
+            f"{self.journal.directory} (epoch {self.epoch}, "
+            f"{state.records_replayed} journal records"
+            + (", TORN TAIL discarded" if state.torn_tail else "")
+            + "); reconciling against host inventory"
+        )
+        # compact NOW, flagged recovering=True via the snapshot
+        # provider (phase is RECOVERING here): a double-crash recovers
+        # from this snapshot (the "snapshot written by a recovering
+        # controller" edge case the tests pin)
+        try:
+            self.journal.write_snapshot()
+        except OSError as e:
+            self.logger.error(f"recovery snapshot failed: {e}")
+        return report
+
+    def adopt_recovered_specs(
+        self, app_id: str, specs: list, acl: Any = None
+    ) -> bool:
+        """Re-attach a freshly BUILT app to its journal-recovered
+        intent instead of re-deploying it. The apps manager's own
+        record recovery redeploys every recorded app at worker start;
+        when the control journal already resurrected the controller
+        half (status ``RECOVERING``), a second ``deploy`` would be
+        rejected as a duplicate — instead the recovered specs take the
+        build's LIVE instance factories (so local placements stop
+        paying the payload rebuild) and reconcile proceeds untouched.
+        Returns False when the app is not in journal recovery (caller
+        should deploy normally)."""
+        app = self.apps.get(app_id)
+        if app is None or app.status != "RECOVERING":
+            return False
+        for spec in specs:
+            current = app.specs.get(spec.name)
+            if current is None:
+                # deployment added since the journal record: place it
+                # like a deploy would, but through the reconcile path
+                app.specs[spec.name] = spec
+                app.replicas.setdefault(spec.name, [])
+                self._init_deployment_plumbing(app_id, spec)
+            else:
+                # keep the recovered spec OBJECT (schedulers and warm
+                # pools hold references to it) — swap in the live build
+                current.instance_factory = spec.instance_factory
+                current.remote_payload = spec.remote_payload
+        if acl is not None:
+            app.acl = acl
+        self.logger.info(
+            f"app '{app_id}' re-attached to journal-recovered intent "
+            f"({len(specs)} spec(s))"
+        )
+        return True
+
+    def _adopt_reported_replica(
+        self, host_id: str, service_id: str, info: dict
+    ) -> bool:
+        """RECOVERY adoption: a rejoining host reports a warm replica
+        the (restarted) controller's routing set does not know. If
+        journaled intent covers it — app recovered, deployment spec
+        present, replica count under the intent — adopt it IN PLACE:
+        same replica_id, chips re-leased via ``host_adopt_chips``, no
+        restart. Mesh shards buffer until every stage reports, then a
+        MeshReplica is rebuilt around them. Anything else returns
+        False and the host is told to drop its copy."""
+        app = self.apps.get(info.get("app_id", ""))
+        if app is None or app.status != "RECOVERING":
+            return False
+        dep = info.get("deployment", "")
+        spec = app.specs.get(dep)
+        if spec is None:
+            return False
+        rid = info.get("replica_id") or ""
+        if info.get("mesh_shard"):
+            return self._adopt_mesh_shard(
+                app, spec, host_id, service_id, info
+            )
+        existing = app.replicas.setdefault(dep, [])
+        for r in existing:
+            if r.replica_id != rid:
+                continue
+            # idempotent re-report (host re-registered twice). This
+            # branch is only reached when _readopt_replica declined —
+            # wrong host, non-routable state, or a lease conflict —
+            # so "keep" must re-establish the lease on the freshly
+            # reset HostRecord, not just wave the copy through.
+            if getattr(r, "host_id", None) != host_id:
+                return False  # duplicate id reported by the wrong host
+            try:
+                self.cluster_state.host_adopt_chips(
+                    host_id, rid, list(info.get("device_ids") or [])
+                )
+            except Exception as e:  # noqa: BLE001 — lease conflict = drop
+                self.logger.warning(
+                    f"cannot re-lease re-reported {rid} on "
+                    f"'{host_id}': {e}"
+                )
+                return False
+            return True
+        if len(existing) >= spec.num_replicas:
+            return False  # intent already satisfied — surplus copy
+        try:
+            reported = ReplicaState(info.get("state", ""))
+        except ValueError:
+            return False
+        if reported not in ROUTABLE_STATES + (ReplicaState.INITIALIZING,):
+            return False
+        device_ids = list(info.get("device_ids") or [])
+        try:
+            self.cluster_state.host_adopt_chips(host_id, rid, device_ids)
+        except Exception as e:  # noqa: BLE001 — lease conflict = don't adopt
+            self.logger.warning(
+                f"recovery cannot adopt {rid} on '{host_id}': {e}"
+            )
+            return False
+        replica = RemoteReplica(
+            app_id=app.app_id,
+            deployment_name=dep,
+            host_id=host_id,
+            host_service_id=service_id,
+            call_host=self._call_host,
+            payload=spec.remote_payload or {},
+            device_ids=device_ids,
+            max_ongoing_requests=spec.max_ongoing_requests,
+            log_sink=self.cluster_state.append_replica_log,
+        )
+        replica.replica_id = rid  # the host's copy IS the identity
+        replica.state = reported
+        self.cluster_state.register_replica(
+            app.app_id, dep, rid, device_ids, host_id=host_id
+        )
+        existing.append(replica)
+        self._replicas_changed.set()
+        RECONCILE_ADOPTED.inc()
+        if self.reconcile_report is not None:
+            self.reconcile_report["adopted"] += 1
+        self.logger.info(
+            f"recovery adopted {rid} on '{host_id}' "
+            f"({app.app_id}/{dep}, state={reported.value})"
+        )
+        flight.record(
+            "replica.readopt",
+            replica=rid,
+            app=app.app_id,
+            host=host_id,
+            state=reported.value,
+            recovery=True,
+        )
+        return True
+
+    def _adopt_mesh_shard(
+        self, app: AppDeployment, spec: DeploymentSpec,
+        host_id: str, service_id: str, info: dict,
+    ) -> bool:
+        """Buffer one reported mesh shard; once all ``spec.mesh.stages``
+        stages have reported, rebuild the MeshReplica around them (same
+        mesh replica id, shard chips re-leased under it, no shard
+        restarts). Incomplete meshes left at settle are swept — the
+        orphan shards stopped and the mesh re-placed from spec."""
+        if spec.mesh is None:
+            return False
+        from bioengine_tpu.serving.mesh_plan import MeshPlan, ShardAssignment
+
+        shard_info = info.get("mesh_shard") or {}
+        rid = info.get("replica_id") or ""
+        mesh_rid = shard_info.get("mesh_replica_id") or (
+            rid.rsplit("-s", 1)[0] if "-s" in rid else ""
+        )
+        try:
+            stage = int(shard_info.get("stage", -1))
+        except (TypeError, ValueError):
+            return False
+        if not mesh_rid or stage < 0 or stage >= spec.mesh.stages:
+            return False
+        dep = spec.name
+        existing = app.replicas.setdefault(dep, [])
+        if any(r.replica_id == mesh_rid for r in existing):
+            # mesh already rebuilt; this shard belongs to it — but the
+            # re-register reset this host's lease table, so the chips
+            # must be re-leased under the mesh id or the ledger shows
+            # them free and a later placement double-leases the devices
+            try:
+                self.cluster_state.host_adopt_chips(
+                    host_id, mesh_rid, list(info.get("device_ids") or [])
+                )
+            except Exception as e:  # noqa: BLE001 — lease conflict = drop
+                self.logger.warning(
+                    f"cannot re-lease shard {rid} of rebuilt mesh "
+                    f"{mesh_rid} on '{host_id}': {e}"
+                )
+                return False
+            return True
+        pending = self._pending_mesh_shards.setdefault(mesh_rid, {})
+        pending[stage] = {
+            "host_id": host_id,
+            "service_id": service_id,
+            "device_ids": list(info.get("device_ids") or []),
+            "state": info.get("state"),
+            "app_id": app.app_id,
+            "deployment": dep,
+        }
+        if len(pending) < spec.mesh.stages:
+            return True  # keep the shard; siblings may still report
+        if len(existing) >= spec.num_replicas:
+            # surplus mesh: THIS reporter is told to drop its shard,
+            # but the sibling stages were already answered "kept" —
+            # park them for the settle sweep to stop host-side, else
+            # they'd serve unrouted and hold chip leases forever
+            self._pending_mesh_shards.pop(mesh_rid, None)
+            pending.pop(stage, None)
+            if pending:
+                self._surplus_mesh_shards[mesh_rid] = pending
+            return False
+        shards = [
+            ShardAssignment(
+                stage=s,
+                host_id=sh["host_id"],
+                service_id=sh["service_id"],
+                n_chips=len(sh["device_ids"]),
+                device_ids=list(sh["device_ids"]),
+            )
+            for s, sh in sorted(pending.items())
+        ]
+        try:
+            for sh in shards:
+                self.cluster_state.host_adopt_chips(
+                    sh.host_id, mesh_rid, sh.device_ids
+                )
+        except Exception as e:  # noqa: BLE001 — lease conflict = don't adopt
+            self.logger.warning(
+                f"recovery cannot adopt mesh {mesh_rid}: {e}"
+            )
+            self.cluster_state.release_chips(mesh_rid)
+            return False
+        replica = MeshReplica(
+            app_id=app.app_id,
+            deployment_name=dep,
+            plan=MeshPlan(config=spec.mesh, shards=shards),
+            call_host=self._call_host,
+            payload=spec.remote_payload or {},
+            max_ongoing_requests=spec.max_ongoing_requests,
+            log_sink=self.cluster_state.append_replica_log,
+        )
+        replica.replica_id = mesh_rid
+        replica.state = ReplicaState.HEALTHY
+        self.cluster_state.register_replica(
+            app.app_id, dep, mesh_rid, replica.device_ids,
+            host_id=replica.host_id,
+        )
+        existing.append(replica)
+        self._pending_mesh_shards.pop(mesh_rid, None)
+        self._replicas_changed.set()
+        RECONCILE_ADOPTED.inc()
+        if self.reconcile_report is not None:
+            self.reconcile_report["adopted"] += 1
+            self.reconcile_report["mesh_rebuilt"] += 1
+        self.logger.info(
+            f"recovery rebuilt mesh {mesh_rid} over "
+            f"{[s.host_id for s in shards]} ({app.app_id}/{dep})"
+        )
+        flight.record(
+            "replica.readopt",
+            replica=mesh_rid,
+            app=app.app_id,
+            host=replica.host_id,
+            state=replica.state.value,
+            recovery=True,
+            mesh=True,
+        )
+        return True
+
+    def _reconcile_satisfied(self) -> bool:
+        for app in self.apps.values():
+            if app.status != "RECOVERING":
+                continue
+            for name, spec in app.specs.items():
+                if len(app.replicas.get(name, [])) < spec.num_replicas:
+                    return False
+        return not self._pending_mesh_shards
+
+    async def _reconcile_tick(self) -> None:
+        """RECOVERING-phase health tick: wait for hosts to rejoin and
+        report; settle once every intent is satisfied or the grace
+        window closes."""
+        if not self._reconcile_satisfied():
+            if (
+                self._recover_deadline is None
+                or time.monotonic() < self._recover_deadline
+            ):
+                return
+        await self._reconcile_settle()
+
+    async def _reconcile_settle(self) -> None:
+        report = self.reconcile_report or {}
+        # sweep incomplete mesh rebuilds (a sibling stage's host never
+        # came back) AND complete-but-surplus meshes (intent already
+        # satisfied; their early stages were answered "kept" before the
+        # surplus was knowable): stop the shards host-side and let the
+        # normal placement path re-place whatever the diff still needs
+        orphan_meshes = {
+            **self._pending_mesh_shards,
+            **self._surplus_mesh_shards,
+        }
+        for mesh_rid, pending in orphan_meshes.items():
+            for stage, sh in pending.items():
+                try:
+                    await self._call_host(
+                        sh["service_id"], "stop_replica",
+                        f"{mesh_rid}-s{stage}",
+                    )
+                except Exception as e:  # noqa: BLE001 — host may be gone
+                    self.logger.debug(
+                        f"orphan shard stop failed (tolerated): {e}"
+                    )
+            report["dropped"] = report.get("dropped", 0) + 1
+            RECONCILE_DROPPED.inc()
+        self._pending_mesh_shards.clear()
+        self._surplus_mesh_shards.clear()
+        # re-place only the DIFF: what no surviving host still serves
+        for app in list(self.apps.values()):
+            if app.status != "RECOVERING":
+                continue
+            for name, spec in app.specs.items():
+                while (
+                    len(app.replicas.get(name, [])) < spec.num_replicas
+                ):
+                    try:
+                        await self._add_replica(app, spec)
+                    except Exception as e:  # noqa: BLE001 — capacity may come later
+                        self.logger.warning(
+                            f"recovery re-place blocked for "
+                            f"{app.app_id}/{name}: {e}"
+                        )
+                        break
+                    report["replaced"] = report.get("replaced", 0) + 1
+                    RECONCILE_REPLACED.inc()
+            app.status = "RUNNING"
+            for name, spec in app.specs.items():
+                if (app.app_id, name) in self._warm_pools:
+                    spawn_supervised(
+                        self._top_up_warm_pool(app, spec),
+                        name=f"recover-warmpool-{app.app_id}-{name}",
+                        logger=self.logger,
+                    )
+        self.phase = "ACTIVE"
+        self._recover_deadline = None
+        report["settled_at"] = time.time()
+        self._replicas_changed.set()
+        # the settled state is the new baseline snapshot (the provider
+        # reports recovering=False now that the phase is ACTIVE)
+        if self.journal is not None:
+            try:
+                self.journal.write_snapshot()
+            except OSError as e:
+                self.logger.error(f"settle snapshot failed: {e}")
+        flight.record(
+            "controller.recovered",
+            epoch=self.epoch,
+            adopted=report.get("adopted", 0),
+            replaced=report.get("replaced", 0),
+            dropped=report.get("dropped", 0),
+            mesh_rebuilt=report.get("mesh_rebuilt", 0),
+            duration_s=round(
+                time.monotonic()
+                - getattr(self, "_recover_started_mono", time.monotonic()),
+                3,
+            ),
+        )
+        self.logger.info(
+            f"reconcile settled: adopted={report.get('adopted', 0)} "
+            f"replaced={report.get('replaced', 0)} "
+            f"dropped={report.get('dropped', 0)} "
+            f"mesh_rebuilt={report.get('mesh_rebuilt', 0)} "
+            f"(epoch {self.epoch})"
+        )
 
     async def _add_replica(
         self,
@@ -1682,6 +2291,10 @@ class ServeController:
         app = self.apps.pop(app_id, None)
         if app is None:
             return
+        # intent commit: the undeploy is accepted the moment the app
+        # leaves the routing map — a crash mid-teardown must not
+        # resurrect the app at recovery
+        self._journal_append("undeploy", {"app_id": app_id})
         # schedulers close FIRST: queued requests fail fast (typed) and
         # already-dispatched groups drain against replicas that are
         # still routable for a moment longer
@@ -2015,6 +2628,13 @@ class ServeController:
         the 30 s ``replica_health`` timeout must not stall every other
         app's restart."""
         self._prune_dead_hosts()
+        if self.phase == "RECOVERING":
+            # reconcile owns this window: hosts are still rejoining and
+            # reporting inventory, so restarts/autoscale/top-ups here
+            # would double-place replicas a host is about to re-offer —
+            # the verdicts are DEFERRED until the diff is settled
+            await self._reconcile_tick()
+            return
         # DEPLOYING apps are excluded: deploy() is still placing their
         # replicas, and a concurrent restart/top-up here would race it
         # into double-placed replicas and double-leased chips
@@ -2074,11 +2694,20 @@ class ServeController:
                         f"replica restart failed for "
                         f"{app.app_id}/{spec_name}: {e}"
                     )
-            # top up a deployment that fell below min_replicas (e.g. a
-            # restart failed for lack of capacity on an earlier tick, or
-            # a rejoining host was told to drop an already-re-placed
-            # replica) — without this the app would stay degraded even
-            # after capacity returns
+            # top up a deployment that fell below its floor (e.g. a
+            # restart failed for lack of capacity on an earlier tick, a
+            # rejoining host was told to drop an already-re-placed
+            # replica, or a recovery re-place was blocked at settle) —
+            # without this the app would stay degraded even after
+            # capacity returns. With autoscale the floor is
+            # min_replicas (num_replicas tracks actual); with a PINNED
+            # replica count, num_replicas IS the declared intent and
+            # must be restored in full.
+            floor = (
+                spec.min_replicas
+                if spec.autoscale
+                else max(spec.min_replicas, spec.num_replicas)
+            )
             while (
                 len(
                     [
@@ -2088,7 +2717,7 @@ class ServeController:
                         in ROUTABLE_STATES + (ReplicaState.INITIALIZING,)
                     ]
                 )
-                < spec.min_replicas
+                < floor
             ):
                 try:
                     await self._add_replica(app, spec)
@@ -2164,6 +2793,7 @@ class ServeController:
             )
             try:
                 await self._add_replica(app, spec)
+                self._journal_scale(app, spec)
             except Exception as e:
                 self.logger.warning(f"autoscale up blocked: {e}")
         elif (
@@ -2183,6 +2813,22 @@ class ServeController:
                 )
                 app.replicas[spec.name].remove(victim)
                 await self._retire_replica(victim)
+                self._journal_scale(app, spec)
+
+    def _journal_scale(self, app: AppDeployment, spec: DeploymentSpec) -> None:
+        """Autoscale verdicts are intent changes: the journaled replica
+        target moves with them so a crash after a scale-up recovers to
+        the SCALED size, not the deploy-time one. Journaled at intent
+        commit (the scale happened) — never per request."""
+        spec.num_replicas = len(app.replicas.get(spec.name, []))
+        self._journal_append(
+            "scale",
+            {
+                "app_id": app.app_id,
+                "deployment": spec.name,
+                "num_replicas": spec.num_replicas,
+            },
+        )
 
     async def _autoscale_predictive(
         self,
@@ -2209,6 +2855,7 @@ class ServeController:
             try:
                 await self._add_replica(app, spec)
                 self._replicas_changed.set()
+                self._journal_scale(app, spec)
             except Exception as e:  # noqa: BLE001 — capacity may come later
                 self.logger.warning(f"predictive autoscale up blocked: {e}")
         elif decision == "down" and len(healthy) > spec.min_replicas:
@@ -2223,6 +2870,7 @@ class ServeController:
                 )
                 app.replicas[spec.name].remove(victim)
                 await self._retire_replica(victim)
+                self._journal_scale(app, spec)
 
     # ---- status -------------------------------------------------------------
 
@@ -2234,6 +2882,13 @@ class ServeController:
             "app_id": app_id,
             "status": app.status,
             "created_at": app.created_at,
+            # the fencing epoch + phase: `bioengine apps status` shows
+            # these so an operator can watch a reconcile converge
+            "controller": {
+                "epoch": self.epoch,
+                "phase": self.phase,
+                "reconcile": self.reconcile_report,
+            },
             "cost": self._cost_rollup(app_id),
             "deployments": {
                 name: self._describe_deployment(app_id, name, replicas)
@@ -2570,6 +3225,14 @@ class ServeController:
             ),
             "metrics": metrics.collect(),
             "slo": self.slo.status(),
+            "controller": {
+                "epoch": self.epoch,
+                "phase": self.phase,
+                "reconcile": self.reconcile_report,
+            },
+            "journal": (
+                self.journal.describe() if self.journal is not None else None
+            ),
             "compile_tier": self.compile_tier.stats(),
             "telemetry": self.telemetry.describe(),
             "cluster": self.cluster_state.snapshot(),

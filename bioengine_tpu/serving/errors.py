@@ -56,6 +56,24 @@ class ApplicationError(Exception):
     an explicitly-final error through the retry layer.)"""
 
 
+class StaleEpochError(RuntimeError):
+    """A control verb carried a LOWER controller epoch than this host
+    has already seen — the sender is a wedged-then-revived old
+    controller that lost a crash/upgrade race. The verb is rejected
+    (epoch fencing, the split-brain guard): deliberately NOT a
+    transport error, because retrying the same stale verb can never
+    succeed and failing it over would just spray the stale intent at
+    another host. Classified APPLICATION both locally and over the
+    wire (``RemoteError.type_name == "StaleEpochError"`` is not in the
+    retryable set)."""
+
+    def __init__(self, message: str, seen_epoch: int = 0,
+                 got_epoch: int = 0):
+        super().__init__(message)
+        self.seen_epoch = seen_epoch
+        self.got_epoch = got_epoch
+
+
 class AdmissionRejectedError(RuntimeError):
     """The global scheduler shed this request at admission (queue depth
     over budget, tenant quota exhausted, or a deadline that could never

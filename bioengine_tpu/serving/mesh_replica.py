@@ -314,6 +314,10 @@ class MeshReplica(ReplicaStateMixin):
                         "n_stages": self.config.stages,
                         "kind": self.config.kind,
                         "axes": dict(self.config.axes),
+                        # the parent identity a RECOVERING controller
+                        # groups surviving shards by when it rebuilds
+                        # the MeshReplica from host inventory
+                        "mesh_replica_id": self.replica_id,
                     },
                 )
                 shard_states.append(ReplicaState(result["state"]))

@@ -1290,11 +1290,10 @@ class DeploymentScheduler:
             ),
         }
 
-    async def close(self) -> None:
-        """Undeploy path: fail everything still waiting (typed, so
-        idempotent callers fail over / surface cleanly) and drain
-        in-flight groups — dispatched work finishes against replicas
-        the controller is about to drain anyway."""
+    def _fail_pending(self, reason: str) -> None:
+        """Shared teardown flush: stop the timers, empty every class
+        queue and open group, and fail each stranded request typed (so
+        idempotent callers fail over / surface cleanly)."""
         self._closed = True
         for signature in list(self._timers):
             self._cancel_timer(signature)
@@ -1310,10 +1309,26 @@ class DeploymentScheduler:
             if not r.future.done():
                 r.future.set_exception(
                     ReplicaUnavailableError(
-                        f"{self.app_id}/{self.deployment} scheduler closed "
-                        f"(undeploy)"
+                        f"{self.app_id}/{self.deployment} {reason}"
                     )
                 )
+
+    def kill(self) -> None:
+        """Crash-path teardown (the scenario engine's SIGKILL
+        emulation): the process owning this scheduler is "gone" — every
+        queued / open-group request fails typed IMMEDIATELY (exactly
+        what a severed client connection would surface) and in-flight
+        groups are left to die with their transport. Unlike
+        :meth:`close`, nothing is drained: a dead process drains
+        nothing."""
+        self._fail_pending("control plane died with this request queued")
+
+    async def close(self) -> None:
+        """Undeploy path: fail everything still waiting (typed, so
+        idempotent callers fail over / surface cleanly) and drain
+        in-flight groups — dispatched work finishes against replicas
+        the controller is about to drain anyway."""
+        self._fail_pending("scheduler closed (undeploy)")
         # bounded, like every other drain in the shutdown path: a group
         # wedged inside a stuck instance must not wedge undeploy — the
         # replica drain/stop that follows owns stranded calls

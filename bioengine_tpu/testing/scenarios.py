@@ -113,7 +113,9 @@ class FaultEvent:
     """One scripted incident step, pinned to a tick."""
 
     at_tick: int
-    action: str                  # kill_host | respawn_host | slow_ramp | blip | clear_faults
+    # kill_host | respawn_host | slow_ramp | blip | clear_faults |
+    # kill_controller | restart_controller | stale_verb
+    action: str
     host: Optional[str] = None
     delay_s: float = 0.2         # slow_ramp target delay
     ramp_hits: int = 12          # slow_ramp hits to reach full delay
@@ -156,6 +158,16 @@ class Scenario:
     recovery_factor: float = 2.0
     # outlier-detector overrides for the defenses leg (time-compressed)
     outlier: dict = field(default_factory=dict)
+    # durable control plane: give the controller a journal directory
+    # under the scenario workdir so kill_controller/restart_controller
+    # can exercise crash recovery (serving/journal.py)
+    durable: bool = False
+    # driver-level retry for idempotent strict traffic: a client whose
+    # CONTROLLER died retries through the restarted one while its
+    # deadline budget lasts — the honest model of "zero failed
+    # idempotent requests" across a control-plane restart (in-replica
+    # failover can't help when the router itself is gone)
+    client_retry: bool = False
 
 
 # ---------------------------------------------------------------------------
@@ -217,11 +229,17 @@ def _build_app_dir(root: Path, scenario: Scenario) -> Path:
     scenario app's manifest + source for the AppBuilder."""
     app_dir = root / "scenario-src"
     app_dir.mkdir(parents=True, exist_ok=True)
-    (app_dir / "manifest.yaml").write_text(
-        _MANIFEST.format(
-            n_replicas=scenario.n_replicas, chips=scenario.chips_per_replica
-        )
+    manifest = _MANIFEST.format(
+        n_replicas=scenario.n_replicas, chips=scenario.chips_per_replica
     )
+    if scenario.scheduling:
+        # remote scenarios opt into the global scheduler through the
+        # same manifest vocabulary operators use
+        lines = ["    scheduling:"]
+        for k, v in scenario.scheduling.items():
+            lines.append(f"      {k}: {v}")
+        manifest += "\n".join(lines) + "\n"
+    (app_dir / "manifest.yaml").write_text(manifest)
     (app_dir / "scenario_dep.py").write_text(
         _SOURCE.format(service_s=scenario.service_s)
     )
@@ -273,6 +291,11 @@ class _Plane:
         self.hosts: dict[str, Any] = {}
         self.dead_hosts: dict[str, Any] = {}
         self._token = None
+        self._port: Optional[int] = None
+        self._outlier = None
+        # SIGKILL'd controllers, kept so stale_verb can replay a
+        # lower-epoch verb from them (the split-brain probe)
+        self.old_controllers: list[Any] = []
         self.app_id = "scenario-app"
         self.deployment = "scenario_dep"
 
@@ -299,19 +322,15 @@ class _Plane:
             **s.outlier,
         }
         outlier = OutlierConfig(enabled=self.defenses, **outlier_kwargs)
+        self._outlier = outlier
         if s.n_hosts > 0:
             from bioengine_tpu.rpc.server import RpcServer
 
             self.server = RpcServer(host="127.0.0.1", admin_users=["admin"])
             await self.server.start()
+            self._port = self.server.port
             self._token = self.server.issue_token("admin", is_admin=True)
-            self.controller = ServeController(
-                ClusterState(
-                    TpuTopology(chips=(), n_hosts=1, platform="cpu")
-                ),
-                health_check_period=3600,
-                outlier_config=outlier,
-            )
+            self.controller = self._make_controller()
             self.controller.attach_rpc(self.server, admin_users=["admin"])
             for i in range(s.n_hosts):
                 await self.spawn_host(f"h{i + 1}")
@@ -342,6 +361,87 @@ class _Plane:
                     )
                 ],
             )
+
+    def _make_controller(self):
+        from bioengine_tpu.cluster.state import ClusterState
+        from bioengine_tpu.cluster.topology import TpuTopology
+        from bioengine_tpu.serving import ServeController
+
+        kwargs: dict = {}
+        if self.scenario.durable:
+            kwargs["control_dir"] = str(self.workdir / "control")
+        return ServeController(
+            ClusterState(TpuTopology(chips=(), n_hosts=1, platform="cpu")),
+            health_check_period=3600,
+            outlier_config=self._outlier,
+            **kwargs,
+        )
+
+    async def kill_controller(self) -> None:
+        """SIGKILL-equivalent control-plane teardown: the RPC server
+        vanishes (every host's websocket closes — they go ORPHANED and
+        start rejoin backoff) and the controller object is abandoned
+        mid-state: no drains, no undeploys, no journal goodbye. The
+        journal directory is all that survives."""
+        # self.controller keeps pointing at the dead object until the
+        # restart lands — exactly what a client with a stale reference
+        # sees; its calls fail fast (provider gone) and client_retry
+        # carries them across
+        self.old_controllers.append(self.controller)
+        server, self.server = self.server, None
+        if server is not None:
+            await server.stop()
+        # callers queued inside the dead controller's schedulers would
+        # otherwise wait out their full deadline — in a real SIGKILL
+        # their connection to the controller process dies, so emulate
+        # that: fail queued work typed NOW, drain nothing
+        for sched in self.controller._schedulers.values():
+            sched.kill()
+        logger.info("scenario: controller killed (SIGKILL-equivalent)")
+
+    async def restart_controller(self) -> None:
+        """A fresh controller process-equivalent on the SAME port and
+        admin token: replays snapshot+journal into RECOVERING, attaches
+        the router, and lets the hosts' reconnect loops bring their
+        warm-replica inventory back for reconcile."""
+        from bioengine_tpu.rpc.server import RpcServer
+
+        server = RpcServer(
+            host="127.0.0.1", port=self._port, admin_users=["admin"]
+        )
+        await server.start()
+        # hosts reconnect with the token the OLD control plane issued —
+        # the restarted one must honor it (prod: pre-shared admin token)
+        server.issue_token("admin", is_admin=True, token_value=self._token)
+        controller = self._make_controller()
+        await controller.recover()
+        controller.attach_rpc(server, admin_users=["admin"])
+        self.server = server
+        self.controller = controller
+        logger.info(
+            f"scenario: controller restarted (epoch {controller.epoch}, "
+            f"phase {controller.phase})"
+        )
+
+    async def stale_verb(self) -> None:
+        """The split-brain probe: the SIGKILL'd controller 'revives'
+        and issues a lifecycle verb with its stale epoch straight at a
+        host. The host must reject it typed (StaleEpochError) and
+        record ``host.fenced`` — the epoch_fencing_observed invariant
+        reads that evidence."""
+        old = self.old_controllers[-1] if self.old_controllers else None
+        host = next(iter(self.hosts.values()), None)
+        if old is None or host is None or not host.replicas:
+            return
+        rid = next(iter(host.replicas))
+        try:
+            await host.drain_replica(rid, timeout_s=0.1, epoch=old.epoch)
+            logger.warning(
+                "scenario: stale-epoch verb was NOT fenced "
+                "(epoch_fencing_observed will fail)"
+            )
+        except Exception as e:  # noqa: BLE001 — the rejection IS the datum
+            logger.info(f"scenario: stale verb fenced: {e}")
 
     async def spawn_host(self, host_id: str):
         from bioengine_tpu.worker_host import WorkerHost
@@ -413,6 +513,12 @@ class _Plane:
                 await host.connection._abort_connection()
         elif ev.action == "clear_faults":
             faults.clear(ev.point)
+        elif ev.action == "kill_controller":
+            await self.kill_controller()
+        elif ev.action == "restart_controller":
+            await self.restart_controller()
+        elif ev.action == "stale_verb":
+            await self.stale_verb()
         else:
             raise ValueError(f"unknown fault action '{ev.action}'")
 
@@ -491,7 +597,6 @@ async def run_scenario_async(
 
     try:
         await plane.start()
-        handle = plane.controller.get_handle(plane.app_id, plane.deployment)
         fault_by_tick: dict[int, list[FaultEvent]] = {}
         for ev in s.fault_script:
             fault_by_tick.setdefault(ev.at_tick, []).append(ev)
@@ -511,21 +616,42 @@ async def run_scenario_async(
 
         async def one(req: dict) -> None:
             idx = req["idx"]
+            opts = opts_for(req)
             t0 = time.monotonic()
-            try:
-                r = await handle.call(
-                    "work", req["a"], req["b"], options=opts_for(req)
-                )
-                got = r["sum"] if isinstance(r, dict) else None
-                outcomes[idx] = (
-                    "ok" if got == req["a"] + req["b"] else "wrong_result"
-                )
-            except AdmissionRejectedError:
-                outcomes[idx] = "shed"
-            except DeadlineExceeded:
-                outcomes[idx] = "deadline"
-            except Exception as e:  # noqa: BLE001 — the outcome IS the datum
-                outcomes[idx] = f"failed:{type(e).__name__}"
+            # client_retry scenarios re-resolve the handle per attempt:
+            # after a controller restart the surviving object is the
+            # PLANE, not any one controller instance — exactly a real
+            # client reconnecting to the healed control-plane URL
+            budget_until = t0 + (opts.deadline_s or s.deadline_s * scale)
+            while True:
+                try:
+                    handle = plane.controller.get_handle(
+                        plane.app_id, plane.deployment
+                    )
+                    r = await handle.call(
+                        "work", req["a"], req["b"], options=opts
+                    )
+                    got = r["sum"] if isinstance(r, dict) else None
+                    outcomes[idx] = (
+                        "ok" if got == req["a"] + req["b"] else "wrong_result"
+                    )
+                except AdmissionRejectedError:
+                    outcomes[idx] = "shed"
+                except DeadlineExceeded:
+                    outcomes[idx] = "deadline"
+                except Exception as e:  # noqa: BLE001 — the outcome IS the datum
+                    if (
+                        s.client_retry
+                        and req["stream"].idempotent
+                        and time.monotonic() < budget_until - 0.5 * scale
+                    ):
+                        # the control plane itself may be mid-restart —
+                        # an idempotent request is safe to re-issue
+                        # through whatever controller answers next
+                        await asyncio.sleep(0.05 * scale)
+                        continue
+                    outcomes[idx] = f"failed:{type(e).__name__}"
+                break
             latencies[idx] = time.monotonic() - t0
 
         by_tick: dict[int, list[dict]] = {}
@@ -656,6 +782,9 @@ def _evaluate(
         ),
         "coalescing_observed": lambda: _inv_coalescing(plane),
         "flood_shed_observed": lambda: _inv_flood_shed(plane),
+        "no_duplicate_placements": lambda: _inv_no_duplicates(plane),
+        "epoch_fencing_observed": lambda: _inv_fencing(flight_t0),
+        "replicas_adopted": lambda: _inv_adopted(flight_t0),
     }
 
     invariants: dict[str, dict] = {}
@@ -818,6 +947,58 @@ def _inv_recovery(
     return ok, (
         f"tail_p99={tail:.1f}ms vs {s.recovery_factor}x "
         f"baseline_p99={base:.1f}ms"
+    )
+
+
+def _inv_no_duplicates(plane: _Plane) -> tuple[bool, str]:
+    """After a controller restart + reconcile there must be exactly one
+    placement per intent: no duplicate replica ids in any routing set,
+    no routing set over its journaled replica target, and no host-side
+    replica the (current) controller does not route — a leftover copy
+    the reconcile should have dropped or adopted."""
+    problems: list[str] = []
+    routed: set[str] = set()
+    for app in plane.controller.apps.values():
+        for name, reps in app.replicas.items():
+            ids = [r.replica_id for r in reps]
+            routed.update(ids)
+            if len(ids) != len(set(ids)):
+                problems.append(f"{app.app_id}/{name}: duplicate ids {ids}")
+            spec = app.specs.get(name)
+            if spec is not None and len(reps) > spec.num_replicas:
+                problems.append(
+                    f"{app.app_id}/{name}: {len(reps)} replicas over "
+                    f"intent {spec.num_replicas}"
+                )
+    for host_id, host in plane.hosts.items():
+        for rid, r in host.replicas.items():
+            base = rid
+            if getattr(r, "mesh_shard", None):
+                base = (r.mesh_shard or {}).get(
+                    "mesh_replica_id"
+                ) or rid.rsplit("-s", 1)[0]
+            if base not in routed:
+                problems.append(
+                    f"host {host_id} still serves unrouted replica {rid}"
+                )
+    return not problems, "; ".join(problems) or "exactly one placement per intent"
+
+
+def _inv_fencing(flight_t0: float) -> tuple[bool, str]:
+    fenced = flight.get_events(types=("host.fenced",), since=flight_t0)
+    return bool(fenced), f"{len(fenced)} host.fenced event(s)"
+
+
+def _inv_adopted(flight_t0: float) -> tuple[bool, str]:
+    recovered = flight.get_events(
+        types=("controller.recovered",), since=flight_t0
+    )
+    adopted = max(
+        (e["attrs"].get("adopted", 0) for e in recovered), default=0
+    )
+    return adopted > 0, (
+        f"{len(recovered)} controller.recovered event(s), "
+        f"max adopted={adopted}"
     )
 
 
@@ -1025,6 +1206,65 @@ _register(
             "no_stuck_futures",
             "flood_shed_observed",
         ),
+    )
+)
+
+
+# The durable-control-plane acceptance scenario: the CONTROLLER itself
+# is SIGKILL'd mid-mixed-priority traffic (the hosts go orphaned but
+# keep serving warm replicas), restarted against the same journal
+# directory, and must reconcile — re-adopting every surviving replica
+# in place, placing nothing twice, and fencing a lower-epoch verb from
+# the "revived" old controller. Client-side retry models what a real
+# client does when the control-plane URL heals: idempotent requests
+# re-issue, so "zero failed idempotent" spans the restart.
+CONTROLLER_CRASH = _register(
+    Scenario(
+        name="controller_crash",
+        description=(
+            "SIGKILL the controller mid-traffic; journal replay + host "
+            "inventory reconcile recovers with zero loss and epoch "
+            "fencing rejects the old controller"
+        ),
+        ticks=130,
+        tick_s=0.02,
+        health_every=3,
+        n_hosts=2,
+        n_replicas=2,
+        chips_per_replica=2,
+        max_ongoing=16,
+        service_s=0.008,
+        scheduling={
+            "max_batch": 8,
+            "max_wait_ms": 2.0,
+            "max_queue_depth": 1024,
+        },
+        streams=(
+            Stream(name="interactive", priority="interactive", base=2),
+            Stream(name="bulk", priority="bulk", base=1),
+        ),
+        fault_script=(
+            FaultEvent(at_tick=35, action="kill_controller"),
+            FaultEvent(at_tick=45, action="restart_controller"),
+            FaultEvent(at_tick=95, action="stale_verb"),
+        ),
+        hedge=False,            # scheduled deployment — scorer owns placement
+        durable=True,
+        client_retry=True,
+        deadline_s=30.0,
+        max_attempts=8,
+        slo_ms=5000.0,
+        invariants=(
+            "zero_failed_idempotent",
+            "chip_accounting_exact",
+            "no_stuck_futures",
+            "bounded_queues",
+            "no_duplicate_placements",
+            "replicas_adopted",
+            "epoch_fencing_observed",
+        ),
+        recovery_tail=60,
+        recovery_factor=6.0,
     )
 )
 

@@ -118,6 +118,14 @@ class BioEngineWorker:
         self.controller = ServeController(
             cluster_state=self.cluster.state, log_file=self.log_file
         )
+        if self.controller.journal is not None:
+            # durable control plane (BIOENGINE_CONTROL_DIR): replay the
+            # previous life's journaled intent into the RECOVERING
+            # phase BEFORE the router verbs exist — rejoining hosts'
+            # warm-replica inventory then reconciles against it instead
+            # of being told to drop everything. A fresh/empty journal
+            # recovers nothing and the phase stays ACTIVE.
+            await self.controller.recover()
         # multi-host: register the serve-router service so worker_host
         # processes can join and receive replica placements
         self.controller.attach_rpc(self.server, admin_users=self.admin_users)
@@ -709,6 +717,23 @@ class BioEngineWorker:
             },
             "rpc": rpc,
             "cluster": self.cluster.status,
+            # durable control plane: the fencing epoch this controller
+            # serves under, its phase (RECOVERING while a restarted
+            # controller reconciles), and journal stats when enabled
+            "serving": (
+                {
+                    "epoch": self.controller.epoch,
+                    "phase": self.controller.phase,
+                    "reconcile": self.controller.reconcile_report,
+                    "journal": (
+                        self.controller.journal.describe()
+                        if self.controller.journal is not None
+                        else None
+                    ),
+                }
+                if self.controller is not None
+                else None
+            ),
             "applications": apps,
             "datasets": {
                 "server_url": (

@@ -42,6 +42,7 @@ import shutil
 import socket
 import sys
 import tempfile
+import time
 import uuid
 from pathlib import Path
 from typing import Any, Optional
@@ -97,6 +98,7 @@ class WorkerHost:
         log_file: Optional[str] = "off",
         rejoin: bool = True,
         compile_cache_dir: str | Path | None = None,
+        orphan_grace_s: Optional[float] = None,
     ):
         self.server_url = server_url
         self.token = token
@@ -113,6 +115,24 @@ class WorkerHost:
         self.rejoin = rejoin
         self._stop_event = asyncio.Event()
         self._conn_lost = asyncio.Event()
+        # ---- orphan mode + epoch fencing --------------------------------
+        # a host that loses its controller keeps serving in-flight and
+        # queued work and rejoins with backoff; if the controller stays
+        # gone past the grace window the host SELF-DRAINS its replicas
+        # (stops burning chips against intent nobody owns). The epoch
+        # is the controller's journaled fence: verbs stamped with a
+        # LOWER epoch than this host has seen are rejected typed
+        # (StaleEpochError) so a revived old controller cannot issue
+        # conflicting placements.
+        self.orphan_grace_s = (
+            orphan_grace_s
+            if orphan_grace_s is not None
+            else float(os.environ.get("BIOENGINE_ORPHAN_GRACE_S", "600"))
+        )
+        self.controller_epoch = 0
+        self._orphaned_since: Optional[float] = None
+        self._orphan_task: Optional[asyncio.Task] = None
+        self.orphan_drained = False
         # wall-clock skew to the controller (this host minus the
         # controller), RTT-midpoint estimate refreshed on every
         # join/rejoin — rides register_host and every flight record so
@@ -228,7 +248,14 @@ class WorkerHost:
         # NB: positional — kwargs named service_id/method would collide
         # with ServerConnection.call's own parameters
         await self._measure_clock_skew()
-        return await self.connection.call(
+        # early fence: the welcome handshake advertises the controller
+        # epoch — refuse to register with a REVIVED OLD controller
+        # (lower epoch than this host has already served under) before
+        # any verbs flow
+        peer_epoch = getattr(self.connection, "peer_epoch", None)
+        if peer_epoch is not None:
+            self._check_epoch(int(peer_epoch), "register_host")
+        result = await self.connection.call(
             "serve-router",
             "register_host",
             self.host_id,
@@ -238,6 +265,39 @@ class WorkerHost:
             self._replica_inventory(),
             self.clock_skew_s,
         )
+        epoch = result.get("epoch") if isinstance(result, dict) else None
+        if epoch is not None:
+            self._check_epoch(int(epoch), "register_host")
+        return result
+
+    def _check_epoch(self, epoch: Optional[int], verb: str) -> None:
+        """Epoch fencing: reject verbs from a controller epoch LOWER
+        than the highest this host has seen; ratchet forward on higher.
+        ``None`` means a legacy (pre-fencing) controller — accepted, so
+        mixed-version fleets keep working."""
+        if epoch is None:
+            return
+        epoch = int(epoch)
+        if epoch < self.controller_epoch:
+            from bioengine_tpu.serving.errors import StaleEpochError
+
+            flight.record(
+                "host.fenced",
+                severity="warning",
+                host=self.host_id,
+                verb=verb,
+                got_epoch=epoch,
+                seen_epoch=self.controller_epoch,
+            )
+            raise StaleEpochError(
+                f"host '{self.host_id}' rejects {verb} from stale "
+                f"controller epoch {epoch} (already serving epoch "
+                f"{self.controller_epoch})",
+                seen_epoch=self.controller_epoch,
+                got_epoch=epoch,
+            )
+        if epoch > self.controller_epoch:
+            self.controller_epoch = epoch
 
     async def _telemetry_loop(self) -> None:
         """Push periodic metric-delta snapshots (utils/telemetry.py
@@ -399,19 +459,108 @@ class WorkerHost:
                 "deployment": r.deployment_name,
                 "state": r.state.value,
                 "device_ids": list(r.device_ids),
+                # mesh shards carry their stage identity (incl. the
+                # parent mesh replica id) so a RECOVERING controller
+                # can rebuild the MeshReplica around surviving shards
+                "mesh_shard": (
+                    dict(r.mesh_shard)
+                    if getattr(r, "mesh_shard", None)
+                    else None
+                ),
             }
             for rid, r in self.replicas.items()
         ]
 
     def _on_connection_lost(self) -> None:
         self._conn_lost.set()
+        if self._stop_event.is_set() or not self.rejoin:
+            return
+        if self._orphaned_since is None:
+            # ORPHAN MODE: keep serving in-flight + queued work against
+            # warm replicas; the reconnect loop rejoins with backoff.
+            # The grace window bounds how long leased chips serve
+            # intent nobody owns before the host self-drains.
+            self._orphaned_since = time.monotonic()
+            self.logger.warning(
+                f"controller connection lost; serving orphaned "
+                f"({len(self.replicas)} warm replicas, self-drain in "
+                f"{self.orphan_grace_s:.0f}s unless rejoined)"
+            )
+            flight.record(
+                "host.orphaned",
+                severity="warning",
+                host=self.host_id,
+                replicas=len(self.replicas),
+                grace_s=self.orphan_grace_s,
+            )
+            if self.orphan_grace_s > 0:
+                from bioengine_tpu.utils.tasks import spawn_supervised
+
+                self._orphan_task = spawn_supervised(
+                    self._orphan_watch(),
+                    name=f"orphan-watch-{self.host_id}",
+                    logger=self.logger,
+                )
+
+    async def _orphan_watch(self) -> None:
+        """Self-protection: if the controller stays gone past the grace
+        window, drain and stop every replica — in-flight work finishes,
+        then the chips stop serving orphaned intent. The process keeps
+        running (and rejoining); a later controller re-places fresh."""
+        while True:
+            since = self._orphaned_since
+            if since is None or self._stop_event.is_set():
+                return  # rejoined (or shutting down) before the window closed
+            remaining = self.orphan_grace_s - (time.monotonic() - since)
+            if remaining <= 0:
+                break
+            await asyncio.sleep(min(remaining, 1.0))
+        if self._orphaned_since is None:
+            return
+        self.logger.warning(
+            f"orphan grace ({self.orphan_grace_s:.0f}s) expired; "
+            f"self-draining {len(self.replicas)} replicas"
+        )
+        flight.record(
+            "host.orphan_drain",
+            severity="warning",
+            host=self.host_id,
+            replicas=len(self.replicas),
+            grace_s=self.orphan_grace_s,
+        )
+        for rid in list(self.replicas):
+            replica = self.replicas.get(rid)
+            if replica is None:
+                continue
+            try:
+                await replica.drain()
+            except Exception as e:  # noqa: BLE001 — drain is best effort here
+                self.logger.debug(f"orphan drain of {rid}: {e}")
+            await self.stop_replica(rid)
+        self.orphan_drained = True
+
+    def _orphan_recovered(self) -> float:
+        """Back under a controller: cancel the self-drain watchdog.
+        Returns how long the orphan gap lasted (0.0 if none)."""
+        gap = (
+            time.monotonic() - self._orphaned_since
+            if self._orphaned_since is not None
+            else 0.0
+        )
+        self._orphaned_since = None
+        if self._orphan_task is not None:
+            self._orphan_task.cancel()
+            self._orphan_task = None
+        return gap
 
     async def _rejoin_cluster(self) -> None:
         """After the RPC client re-established + re-registered our
         service: announce ourselves to the controller again, with the
         still-warm replica inventory. The controller re-adopts what it
         has not yet re-placed and tells us to drop the rest."""
+        prev_epoch = self.controller_epoch
         joined = await self._register_host()
+        gap_s = self._orphan_recovered()
         dropped = joined.get("drop_replicas") or []
         for rid in dropped:
             self.logger.info(
@@ -422,13 +571,24 @@ class WorkerHost:
         self.logger.info(
             f"rejoined cluster as '{self.host_id}' "
             f"(kept {len(self.replicas)} warm replicas, "
-            f"dropped {len(dropped)})"
+            f"dropped {len(dropped)}, epoch {self.controller_epoch})"
         )
         flight.record(
             "host.rejoin",
             host=self.host_id,
             kept=len(self.replicas),
             dropped=len(dropped),
+        )
+        # the incident-timeline pair of host.orphaned: which controller
+        # EPOCH the host came back under (a restart bumps it; a blip of
+        # the same controller keeps it), and how long the gap was
+        flight.record(
+            "host.rejoined_epoch",
+            host=self.host_id,
+            prev_epoch=prev_epoch,
+            epoch=self.controller_epoch,
+            orphan_gap_s=round(gap_s, 3),
+            kept=len(self.replicas),
         )
 
     async def serve_forever(self) -> None:
@@ -463,6 +623,9 @@ class WorkerHost:
 
     async def stop(self) -> None:
         self._stop_event.set()
+        if self._orphan_task is not None:
+            self._orphan_task.cancel()
+            self._orphan_task = None
         if self._telemetry_task is not None:
             self._telemetry_task.cancel()
             self._telemetry_task = None
@@ -502,12 +665,14 @@ class WorkerHost:
         device_ids: Optional[list[int]] = None,
         max_ongoing_requests: int = 10,
         mesh_shard: Optional[dict] = None,
+        epoch: Optional[int] = None,
     ) -> dict:
         """Build the deployment instance from the shipped artifact
         payload and run the standard replica lifecycle chain."""
         from bioengine_tpu.apps.builder import AppBuilder
         from bioengine_tpu.serving.replica import Replica
 
+        self._check_epoch(epoch, "start_replica")
         if faults.ACTIVE:
             await faults.hit("host.start_replica", scope=self.host_id)
 
@@ -639,10 +804,14 @@ class WorkerHost:
         }
 
     async def drain_replica(
-        self, replica_id: str, timeout_s: Optional[float] = None
+        self,
+        replica_id: str,
+        timeout_s: Optional[float] = None,
+        epoch: Optional[int] = None,
     ) -> dict:
         """Reject new calls on the replica, wait (bounded) for its
         in-flight requests to finish."""
+        self._check_epoch(epoch, "drain_replica")
         replica = self.replicas.get(replica_id)
         if replica is None:
             return {"replica_id": replica_id, "drained": True, "known": False}
@@ -677,7 +846,10 @@ class WorkerHost:
             bytes(payload), env, cwd, timeout
         )
 
-    async def stop_replica(self, replica_id: str) -> dict:
+    async def stop_replica(
+        self, replica_id: str, epoch: Optional[int] = None
+    ) -> dict:
+        self._check_epoch(epoch, "stop_replica")
         replica = self.replicas.pop(replica_id, None)
         if replica is not None:
             await replica.stop()
@@ -755,6 +927,9 @@ class WorkerHost:
         d = {
             "host_id": self.host_id,
             "worker_tag": self.worker_tag,
+            "controller_epoch": self.controller_epoch,
+            "orphaned": self._orphaned_since is not None,
+            "orphan_drained": self.orphan_drained,
             "topology": self.topology.as_dict(),
             "replicas": {
                 rid: r.describe() for rid, r in self.replicas.items()

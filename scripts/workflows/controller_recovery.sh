@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Durable-control-plane gate: the controller must be crash-restartable
+# with zero failed idempotent requests. Three legs:
+#   1. the recovery suite (journal units, snapshot+replay, reconcile
+#      edge cases, orphan mode, epoch fencing, mesh rebuild, CLI)
+#   2. the controller_crash scenario — SIGKILL-equivalent teardown
+#      mid-mixed-priority traffic, restart, reconcile — run twice with
+#      one seed and required to produce identical outcome sequences
+#      and invariant verdicts (determinism double run)
+#   3. the real-subprocess leg: an actual controller process is
+#      SIGKILLed and restarted on the same port + journal dir, and a
+#      live worker host must ride through orphaned -> rejoined with
+#      its replica re-adopted in place
+#
+# Knobs:
+#   BIOENGINE_SCENARIO_SEED    workload seed (default 7)
+set -euo pipefail
+
+cd "$(dirname "$0")/../.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+SEED="${BIOENGINE_SCENARIO_SEED:-7}"
+
+echo "== controller recovery suite (fast legs) =="
+timeout -k 10 600 python -m pytest tests/test_controller_recovery.py \
+    -m "not slow" -q -p no:cacheprovider
+
+echo "== controller_crash scenario (determinism double run, seed ${SEED}) =="
+timeout -k 10 420 python -m bioengine_tpu.cli scenarios run controller_crash \
+    --seed "$SEED" --check-determinism > /dev/null
+
+echo "== real-subprocess kill/restart leg =="
+timeout -k 10 600 python -m pytest tests/test_controller_recovery.py \
+    -m slow -q -rA -p no:cacheprovider
+
+echo "controller recovery gate OK"

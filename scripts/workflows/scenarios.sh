@@ -23,7 +23,7 @@ CYCLES="${BIOENGINE_SCENARIO_CYCLES:-1}"
 
 for cycle in $(seq 1 "$CYCLES"); do
     echo "== scenario suite (cycle ${cycle}/${CYCLES}, seed ${SEED}) =="
-    for name in preemption_storm diurnal_wave blip_storm hot_signature tenant_flood; do
+    for name in preemption_storm diurnal_wave blip_storm hot_signature tenant_flood controller_crash; do
         echo "-- ${name}"
         timeout -k 10 300 python -m bioengine_tpu.cli scenarios run "$name" \
             --seed "$SEED" > /dev/null

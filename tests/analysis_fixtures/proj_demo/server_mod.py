@@ -8,13 +8,20 @@ equals the marker set exactly.
 PROTO_DEMO1 = "demo1"    # offered AND gated: in sync, no finding
 PROTO_UNGATED1 = "ungated1"  # <- BE-DIST-203 (offered, never gated)
 PROTO_UNOFFERED1 = "unoffered1"  # <- BE-DIST-203 (gated, never offered)
+# offered; gated only through the SERVER-side helper (token is the
+# second arg) — in sync, no finding
+PROTO_SRVGATED1 = "srvgated1"
 
-HANDSHAKE_PROTOCOLS = [PROTO_DEMO1, PROTO_UNGATED1]
+HANDSHAKE_PROTOCOLS = [PROTO_DEMO1, PROTO_UNGATED1, PROTO_SRVGATED1]
 
 
 class DemoServer:
     def __init__(self, rpc):
         self.rpc = rpc
+
+    def plan(self, service_id):
+        # gate on what the ws peer that OWNS service_id declared
+        return self.rpc.service_peer_supports(service_id, PROTO_SRVGATED1)
 
     def ping(self):
         return "pong"

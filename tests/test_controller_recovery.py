@@ -1,0 +1,1353 @@
+"""Durable control plane: journaled controller state, crash/upgrade
+recovery with zero-loss reconcile, epoch fencing against split-brain.
+
+Three layers of proof:
+
+- **Journal units** — CRC-guarded append/replay, torn-tail stop,
+  atomic snapshot compaction, epoch monotonicity across restarts, and
+  the full ``DeploymentSpec`` round trip (scheduling / slo /
+  warm_pool / mesh / batching blocks).
+- **In-process crash chaos** — the PR-4-style harness (real
+  websockets, WorkerHost objects in the test loop): the controller is
+  SIGKILL-equivalently torn down mid-idempotent-traffic and restarted
+  against the same journal; zero failed idempotent requests, every
+  surviving replica re-adopted IN PLACE (same host-side instance
+  object — never restarted), chip accounting exact, and a lower-epoch
+  verb from the "old" controller rejected typed. Plus the reconcile
+  edge cases: unknown-replica drop, re-place from spec with no
+  survivors, double-restart from a recovering snapshot, and the
+  orphaned host's grace-window self-drain.
+- **Real subprocess** (slow) — an actual controller process is
+  SIGKILLed and restarted; the in-test worker host rides through
+  orphaned and is re-adopted by the second life.
+"""
+
+import asyncio
+import os
+import signal
+import socket
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from bioengine_tpu.apps.builder import AppBuilder
+from bioengine_tpu.cluster.state import ClusterState
+from bioengine_tpu.cluster.topology import TpuTopology
+from bioengine_tpu.rpc.client import connect_to_server
+from bioengine_tpu.rpc.server import RpcServer
+from bioengine_tpu.serving import (
+    DeploymentSpec,
+    MeshConfig,
+    RequestOptions,
+    SchedulingConfig,
+    ServeController,
+    SLOConfig,
+    StaleEpochError,
+    WarmPoolConfig,
+)
+from bioengine_tpu.serving.journal import (
+    ControlJournal,
+    redact_secrets,
+    spec_from_dict,
+    spec_to_dict,
+)
+from bioengine_tpu.utils import flight
+from bioengine_tpu.worker_host import WorkerHost
+
+pytestmark = [pytest.mark.integration, pytest.mark.anyio]
+
+
+# ---------------------------------------------------------------------------
+# journal units
+# ---------------------------------------------------------------------------
+
+
+class TestJournalUnits:
+    def test_append_replay_roundtrip(self, tmp_path):
+        j = ControlJournal(tmp_path, snapshot_every=1000)
+        j.mint_epoch()
+        j.append("deploy", {"app_id": "a", "specs": [{"name": "d",
+                 "num_replicas": 2}], "acl": ["*"]})
+        j.append("scale", {"app_id": "a", "deployment": "d",
+                 "num_replicas": 3})
+        j.append("deploy", {"app_id": "b", "specs": [{"name": "x"}],
+                 "acl": None})
+        j.append("undeploy", {"app_id": "b"})
+
+        state = ControlJournal(tmp_path).load()
+        assert state.epoch == 1
+        assert set(state.apps) == {"a"}
+        assert state.apps["a"]["specs"][0]["num_replicas"] == 3
+        assert state.apps["a"]["acl"] == ["*"]
+        assert not state.torn_tail
+        assert state.records_replayed == 5
+
+    def test_torn_tail_stops_cleanly(self, tmp_path):
+        """A crash mid-append leaves a truncated final record; replay
+        keeps everything before it and flags the tear instead of
+        raising or silently absorbing garbage."""
+        j = ControlJournal(tmp_path, snapshot_every=1000)
+        j.mint_epoch()
+        j.append("deploy", {"app_id": "a", "specs": [], "acl": None})
+        j.append("deploy", {"app_id": "b", "specs": [], "acl": None})
+        raw = j.journal_path.read_bytes()
+        # cut the final record mid-json — CRC can no longer match
+        j.journal_path.write_bytes(raw[:-10])
+
+        state = ControlJournal(tmp_path).load()
+        assert state.torn_tail
+        assert set(state.apps) == {"a"}
+        assert state.records_replayed == 2  # epoch + first deploy
+
+    def test_corrupt_crc_stops_cleanly(self, tmp_path):
+        j = ControlJournal(tmp_path, snapshot_every=1000)
+        j.append("deploy", {"app_id": "a", "specs": [], "acl": None})
+        raw = j.journal_path.read_bytes()
+        j.journal_path.write_bytes(raw[:-5] + b"X" + raw[-4:])
+        state = ControlJournal(tmp_path).load()
+        assert state.torn_tail
+        assert state.apps == {}
+
+    def test_append_after_torn_tail_starts_clean(self, tmp_path):
+        """``load()`` truncates the torn bytes, so the NEXT append (the
+        restarted controller's minted epoch) lands on a fresh line.
+        Without the truncate it would merge onto the partial line, fail
+        CRC on the following replay, and take the epoch — the
+        split-brain fence — down with it."""
+        j = ControlJournal(tmp_path, snapshot_every=1000)
+        j.mint_epoch()
+        j.append("deploy", {"app_id": "a", "specs": [], "acl": None})
+        raw = j.journal_path.read_bytes()
+        j.journal_path.write_bytes(raw[:-10])  # crash mid-append
+
+        j2 = ControlJournal(tmp_path, snapshot_every=1000)
+        state = j2.load()
+        assert state.torn_tail
+        assert j2.mint_epoch() == 2
+
+        state3 = ControlJournal(tmp_path).load()
+        assert not state3.torn_tail       # the tear was repaired
+        assert state3.epoch == 2          # the minted epoch SURVIVES
+
+    def test_unterminated_final_line_is_torn(self, tmp_path):
+        """A final line missing only its newline is a torn write even
+        when the record body is intact: ``append`` fsyncs the full
+        line, so the record was never acked — it must be dropped, not
+        merged into by the next append."""
+        j = ControlJournal(tmp_path, snapshot_every=1000)
+        j.append("deploy", {"app_id": "a", "specs": [], "acl": None})
+        j.append("deploy", {"app_id": "b", "specs": [], "acl": None})
+        raw = j.journal_path.read_bytes()
+        j.journal_path.write_bytes(raw[:-1])  # strip ONLY the newline
+        state = ControlJournal(tmp_path).load()
+        assert state.torn_tail
+        assert set(state.apps) == {"a"}
+
+    def test_snapshot_compaction(self, tmp_path):
+        """Every ``snapshot_every`` appends the folded state lands in
+        snapshot.json (atomic rename) and the journal restarts empty —
+        replay cost is bounded by cadence, not uptime."""
+        j = ControlJournal(tmp_path, snapshot_every=3)
+        j.mint_epoch()
+        j.set_snapshot_state(
+            {"a": {"specs": [{"name": "d"}], "acl": None}}, ["admin"]
+        )
+        j.append("deploy", {"app_id": "a", "specs": [{"name": "d"}],
+                 "acl": None})
+        j.append("scale", {"app_id": "a", "deployment": "d",
+                 "num_replicas": 2})
+        assert j.snapshots_written == 1
+        assert j.journal_path.stat().st_size == 0
+        assert j.snapshot_path.exists()
+
+        state = ControlJournal(tmp_path).load()
+        assert state.snapshot_loaded
+        assert set(state.apps) == {"a"}
+        assert state.admins == ["admin"]
+        assert state.epoch == 1
+
+    def test_epoch_monotonic_across_restarts(self, tmp_path):
+        epochs = []
+        for _ in range(4):
+            j = ControlJournal(tmp_path)
+            j.load()
+            epochs.append(j.mint_epoch())
+        assert epochs == [1, 2, 3, 4]
+
+    def test_epoch_survives_snapshot_compaction(self, tmp_path):
+        j = ControlJournal(tmp_path, snapshot_every=1)
+        j.load()
+        j.mint_epoch()           # append triggers an immediate snapshot
+        assert j.journal_path.stat().st_size == 0
+        j2 = ControlJournal(tmp_path)
+        j2.load()
+        assert j2.mint_epoch() == 2
+
+    def test_redact_secrets(self):
+        doc = {
+            "env_vars": {"BIOENGINE_ADMIN_TOKEN": "s3cret", "N": 4},
+            "api_key": "xyz",
+            "files": {"main.py": "print('hello world')"},
+            "nested": [{"password": "p"}],
+            "name": "ok",
+        }
+        red = redact_secrets(doc)
+        assert red["env_vars"]["BIOENGINE_ADMIN_TOKEN"] == "***redacted***"
+        assert red["env_vars"]["N"] == 4
+        assert red["api_key"] == "***redacted***"
+        assert red["nested"][0]["password"] == "***redacted***"
+        assert "hello" not in str(red["files"])
+        assert red["name"] == "ok"
+
+    def test_inspect_tail_and_describe(self, tmp_path):
+        j = ControlJournal(tmp_path, snapshot_every=1000)
+        j.mint_epoch()
+        for i in range(5):
+            j.append("deploy", {"app_id": f"a{i}", "specs": [],
+                     "acl": None})
+        info = j.inspect(tail=3)
+        assert info["journal_records"] == 6
+        assert len(info["tail"]) == 3
+        assert not info["torn_tail"]
+        d = j.describe()
+        assert d["records_written"] == 6
+        assert d["epoch"] == 1
+
+
+class TestSpecRoundTrip:
+    def test_all_config_blocks_roundtrip(self):
+        spec = DeploymentSpec(
+            name="dep",
+            instance_factory=lambda: None,
+            num_replicas=3,
+            min_replicas=2,
+            max_replicas=5,
+            chips_per_replica=2,
+            max_ongoing_requests=7,
+            autoscale=False,
+            target_load=0.6,
+            max_batch=16,
+            max_wait_ms=4.5,
+            scheduling=SchedulingConfig(
+                max_batch=8, tenant_quota=6, class_weights={"interactive": 8.0}
+            ),
+            slo=SLOConfig(latency_objective_s=0.25, availability=99.9,
+                          window_s=3600.0),
+            warm_pool=WarmPoolConfig(size=2, max_size=4,
+                                     telemetry_sized=True),
+            mesh=MeshConfig(stages=2, chips_per_stage=2, kind="pipeline",
+                            entry_methods=("predict",)),
+            remote_payload={"app_id": "a", "deployment": "dep",
+                            "files": {"m.py": "x = 1"}},
+        )
+        d = spec_to_dict(spec)
+        import json
+
+        d = json.loads(json.dumps(d))  # must survive the journal's JSON trip
+        back = spec_from_dict(d, "a")
+        assert back.name == "dep"
+        assert back.num_replicas == 3
+        assert back.chips_per_replica == 2
+        assert back.autoscale is False
+        assert back.max_batch == 16 and back.max_wait_ms == 4.5
+        assert back.scheduling.max_batch == 8
+        assert back.scheduling.tenant_quota == 6
+        assert back.slo.latency_objective_s == 0.25
+        assert back.warm_pool.size == 2 and back.warm_pool.telemetry_sized
+        assert back.mesh.stages == 2
+        assert back.mesh.entry_methods == ("predict",)
+        assert back.remote_payload["files"]["m.py"] == "x = 1"
+
+    def test_local_only_spec_fails_loudly_at_placement(self):
+        spec = DeploymentSpec(name="d", instance_factory=lambda: None)
+        back = spec_from_dict(spec_to_dict(spec), "a")
+        with pytest.raises(RuntimeError, match="redeploy"):
+            back.instance_factory()
+
+
+# ---------------------------------------------------------------------------
+# in-process crash/recovery harness (real websockets)
+# ---------------------------------------------------------------------------
+
+REC_MANIFEST = """\
+name: Recovery App
+id: rec-app
+id_emoji: "\U0001F9EA"
+description: idempotent arithmetic for recovery traffic
+type: tpu-serve
+version: 1.0.0
+deployments:
+  - rec_dep:RecDep
+authorized_users: ["*"]
+deployment_config:
+  rec_dep:
+    num_replicas: 2
+    min_replicas: 2
+    max_replicas: 2
+    chips: 2
+    autoscale: false
+"""
+
+REC_SOURCE = '''\
+from bioengine_tpu.rpc import schema_method
+
+
+class RecDep:
+    def __init__(self):
+        self.calls = 0
+
+    @schema_method
+    async def add(self, a: int, b: int, context=None):
+        """Idempotent arithmetic."""
+        self.calls += 1
+        return {"sum": a + b}
+'''
+
+
+def _no_local_chips() -> ClusterState:
+    return ClusterState(TpuTopology(chips=(), n_hosts=1, platform="cpu"))
+
+
+def _write_rec_app(tmp_path: Path) -> Path:
+    app_dir = tmp_path / "rec-src"
+    app_dir.mkdir(exist_ok=True)
+    (app_dir / "manifest.yaml").write_text(REC_MANIFEST)
+    (app_dir / "rec_dep.py").write_text(REC_SOURCE)
+    return app_dir
+
+
+class DurablePlane:
+    """Controller + RpcServer pair that can be crashed (SIGKILL
+    equivalent: server torn down, controller object abandoned) and
+    restarted on the same port/token against the same journal dir."""
+
+    TOKEN = "recovery-admin-token"
+
+    def __init__(self, tmp_path: Path):
+        self.tmp_path = tmp_path
+        self.control_dir = tmp_path / "control"
+        self.server = None
+        self.controller = None
+        self.port = None
+        self.hosts: list[WorkerHost] = []
+        self.dead_controllers: list[ServeController] = []
+
+    async def start(self):
+        self.server = RpcServer(host="127.0.0.1", admin_users=["admin"])
+        await self.server.start()
+        self.port = self.server.port
+        self.server.issue_token("admin", is_admin=True,
+                                token_value=self.TOKEN)
+        self.controller = ServeController(
+            _no_local_chips(), health_check_period=3600,
+            control_dir=str(self.control_dir),
+        )
+        self.controller.attach_rpc(self.server, admin_users=["admin"])
+        return self
+
+    async def spawn_host(self, host_id, rejoin=True, orphan_grace_s=60.0):
+        host = WorkerHost(
+            server_url=self.server.url,
+            token=self.TOKEN,
+            host_id=host_id,
+            workspace_dir=self.tmp_path / f"ws-{host_id}",
+            rejoin=rejoin,
+            orphan_grace_s=orphan_grace_s,
+        )
+        await host.start()
+        host.connection.reconnect_max_backoff_s = 0.3
+        self.hosts.append(host)
+        return host
+
+    async def deploy(self, app_id="rec-app"):
+        builder = AppBuilder(workdir_root=self.tmp_path / "apps")
+        built = builder.build(
+            app_id=app_id, local_path=_write_rec_app(self.tmp_path)
+        )
+        await self.controller.deploy(app_id, built.specs)
+        return self.controller.apps[app_id].replicas["rec_dep"]
+
+    async def crash(self):
+        """SIGKILL-equivalent: no drains, no undeploy, no journal
+        goodbye — the server vanishes and the object is abandoned."""
+        self.dead_controllers.append(self.controller)
+        server, self.server = self.server, None
+        await server.stop()
+        for sched in self.controller._schedulers.values():
+            sched.kill()
+
+    async def restart(self, recover=True, grace_s=3.0):
+        server = RpcServer(
+            host="127.0.0.1", port=self.port, admin_users=["admin"]
+        )
+        await server.start()
+        server.issue_token("admin", is_admin=True, token_value=self.TOKEN)
+        controller = ServeController(
+            _no_local_chips(), health_check_period=3600,
+            control_dir=str(self.control_dir),
+        )
+        controller.reconcile_grace_s = grace_s
+        if recover:
+            await controller.recover()
+        controller.attach_rpc(server, admin_users=["admin"])
+        self.server = server
+        self.controller = controller
+        return controller
+
+    async def settle(self, timeout=12.0):
+        """Drive health ticks until the reconcile flips ACTIVE."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            await self.controller.health_tick()
+            if self.controller.phase == "ACTIVE":
+                return
+            await asyncio.sleep(0.05)
+        raise AssertionError(
+            f"reconcile never settled (phase={self.controller.phase}, "
+            f"report={self.controller.reconcile_report})"
+        )
+
+    async def stop(self):
+        for host in self.hosts:
+            try:
+                await host.stop()
+            except Exception:
+                pass
+        if self.controller is not None:
+            try:
+                await self.controller.stop()
+            except Exception:
+                pass
+        if self.server is not None:
+            await self.server.stop()
+
+
+@pytest.fixture()
+async def plane(tmp_path):
+    p = DurablePlane(tmp_path)
+    await p.start()
+    try:
+        yield p
+    finally:
+        await p.stop()
+
+
+def _host_leases(plane):
+    """host_id -> {chip: replica_id} from the CURRENT controller."""
+    return {
+        h.host_id: dict(h.chips_in_use)
+        for h in plane.controller.cluster_state.hosts.values()
+        if h.alive
+    }
+
+
+class TestCrashRecovery:
+    async def test_crash_restart_mid_traffic_zero_loss(self, plane):
+        """THE acceptance: controller SIGKILLed and restarted
+        mid-idempotent-traffic → zero failed requests, all surviving
+        replicas re-adopted in place (same host-side instance objects,
+        never restarted), chip accounting exact, and a lower-epoch
+        verb from the old controller rejected typed."""
+        t0 = time.time()
+        h1 = await plane.spawn_host("h1")
+        h2 = await plane.spawn_host("h2")
+        replicas = await plane.deploy()
+        assert sorted(r.host_id for r in replicas) == ["h1", "h2"]
+        old_epoch = plane.controller.epoch
+        rids_before = sorted(r.replica_id for r in replicas)
+        instances_before = {
+            rid: id(r.instance)
+            for host in (h1, h2)
+            for rid, r in host.replicas.items()
+        }
+        calls_before = {
+            rid: r.instance.calls
+            for host in (h1, h2)
+            for rid, r in host.replicas.items()
+        }
+
+        failures: list = []
+        done = [0]
+
+        async def one_call(i: int) -> None:
+            deadline = time.monotonic() + 25
+            while True:
+                try:
+                    handle = plane.controller.get_handle("rec-app")
+                    r = await handle.call(
+                        "add", i, 1,
+                        options=RequestOptions(
+                            idempotent=True, deadline_s=5, max_attempts=6,
+                            backoff_base_s=0.02, backoff_cap_s=0.2,
+                        ),
+                    )
+                    assert r["sum"] == i + 1
+                    done[0] += 1
+                    return
+                except Exception as e:  # noqa: BLE001 — retry across the restart
+                    if time.monotonic() > deadline:
+                        failures.append((i, e))
+                        return
+                    await asyncio.sleep(0.05)
+
+        async def traffic():
+            tasks = []
+            for i in range(60):
+                tasks.append(asyncio.create_task(one_call(i)))
+                await asyncio.sleep(0.01)
+            await asyncio.gather(*tasks)
+
+        traffic_task = asyncio.create_task(traffic())
+        await asyncio.sleep(0.15)          # ~15 requests in flight/done
+        await plane.crash()
+        await asyncio.sleep(0.2)           # hosts notice: ORPHANED
+        assert h1._orphaned_since is not None
+        controller = await plane.restart(grace_s=5.0)
+        assert controller.phase == "RECOVERING"
+        assert controller.epoch == old_epoch + 1
+        await plane.settle()
+        await traffic_task
+
+        # zero failed idempotent requests across the whole restart
+        assert failures == [], failures[:3]
+        assert done[0] == 60
+
+        # every surviving replica re-adopted IN PLACE: same ids in the
+        # new routing set, same instance objects host-side (and their
+        # call counters kept counting — never restarted)
+        new_replicas = controller.apps["rec-app"].replicas["rec_dep"]
+        assert sorted(r.replica_id for r in new_replicas) == rids_before
+        report = controller.reconcile_report
+        assert report["adopted"] == 2
+        assert report["replaced"] == 0
+        assert report["dropped"] == 0
+        for host in (h1, h2):
+            for rid, r in host.replicas.items():
+                assert id(r.instance) == instances_before[rid]
+                assert r.instance.calls >= calls_before[rid]
+
+        # chip accounting exact: each host leases exactly its adopted
+        # replica's chips, nothing else
+        leases = _host_leases(plane)
+        for r in new_replicas:
+            held = sorted(
+                c for c, owner in leases[r.host_id].items()
+                if owner == r.replica_id
+            )
+            assert held == sorted(r.device_ids)
+        assert sum(len(l) for l in leases.values()) == sum(
+            len(r.device_ids) for r in new_replicas
+        )
+
+        # the hosts came back under the NEW epoch, with the orphan gap
+        # on the incident timeline
+        assert h1.controller_epoch == controller.epoch
+        events = {
+            e["type"] for e in flight.get_events(
+                types=("host.orphaned", "host.rejoined_epoch",
+                       "controller.recovering", "controller.recovered"),
+                since=t0,
+            )
+        }
+        assert events == {
+            "host.orphaned", "host.rejoined_epoch",
+            "controller.recovering", "controller.recovered",
+        }
+
+        # split-brain fence: the dead controller's epoch is rejected
+        # typed on every stamped verb
+        victim = next(iter(h1.replicas))
+        with pytest.raises(StaleEpochError):
+            await h1.drain_replica(victim, timeout_s=0.1, epoch=old_epoch)
+        with pytest.raises(StaleEpochError):
+            await h1.stop_replica(victim, epoch=old_epoch)
+        assert h1.replicas[victim].state.value in (
+            "HEALTHY", "TESTING"
+        )  # the stale verbs did NOT drain/stop anything
+        fenced = flight.get_events(types=("host.fenced",), since=t0)
+        assert len(fenced) == 2
+
+    async def test_unknown_replica_is_dropped(self, plane):
+        """Reconcile edge: a host reports a replica the journal has no
+        intent for (here: the journal was wiped — the 'absent from the
+        journal' case). Decision pinned: DROP — the journal is the
+        intent of record; the host discards its copy and the chips
+        lease nothing."""
+        h1 = await plane.spawn_host("h1")
+        await plane.deploy()
+        assert len(h1.replicas) >= 1
+        await plane.crash()
+        # wipe the journal: the restarted controller knows nothing
+        for f in plane.control_dir.iterdir():
+            f.unlink()
+        controller = await plane.restart(recover=True, grace_s=1.0)
+        assert controller.phase == "ACTIVE"  # no journaled apps
+        # the host rejoins and is told to drop its now-unowned replica
+        deadline = time.monotonic() + 8
+        while h1.replicas and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        assert h1.replicas == {}
+        assert controller.apps == {}
+        leases = _host_leases(plane)
+        assert all(not l for l in leases.values()), leases
+
+    async def test_replace_from_spec_when_no_survivors(self, plane):
+        """Reconcile edge: journaled intent but every host that served
+        it died with the controller — the diff is the whole deployment,
+        re-placed from the journaled spec on whatever capacity joins."""
+        h1 = await plane.spawn_host("h1")
+        await plane.deploy()
+        await plane.crash()
+        # the serving host dies too — nothing survives to adopt
+        h1.rejoin = False
+        h1.connection.auto_reconnect = False
+        h1.connection._closing = True
+        await h1.connection._abort_connection()
+        controller = await plane.restart(grace_s=1.5)
+        assert controller.phase == "RECOVERING"
+        # a FRESH host joins with no warm replicas at all
+        await plane.spawn_host("h3")
+        await plane.settle()
+        report = controller.reconcile_report
+        assert report["adopted"] == 0
+        assert report["replaced"] == 2
+        replicas = controller.apps["rec-app"].replicas["rec_dep"]
+        assert len(replicas) == 2
+        assert all(r.host_id == "h3" for r in replicas)
+        # the re-placed deployment serves
+        handle = controller.get_handle("rec-app")
+        r = await handle.call("add", 20, 22)
+        assert r["sum"] == 42
+
+    async def test_pinned_intent_topped_up_after_blocked_settle(
+        self, plane
+    ):
+        """Reconcile edge: the grace window closes while capacity is
+        still gone, so the settle's re-place is blocked and the app
+        goes RUNNING under-provisioned. That must not be permanent:
+        when capacity returns, the health tick restores a PINNED
+        (autoscale=false) deployment to its full ``num_replicas``
+        intent — not just the ``min_replicas`` floor."""
+        h1 = await plane.spawn_host("h1")
+        await plane.deploy()
+        await plane.crash()
+        h1.rejoin = False
+        h1.connection.auto_reconnect = False
+        h1.connection._closing = True
+        await h1.connection._abort_connection()
+        controller = await plane.restart(grace_s=0.4)
+        spec = controller.apps["rec-app"].specs["rec_dep"]
+        # pinned intent ABOVE the min floor: the old min-only top-up
+        # would stop one short
+        spec.min_replicas = 1
+        assert not spec.autoscale and spec.num_replicas == 2
+        await asyncio.sleep(0.5)          # let the grace window lapse
+        await controller.health_tick()    # settles; re-place blocked
+        assert controller.phase == "ACTIVE"
+        app = controller.apps["rec-app"]
+        assert len(app.replicas["rec_dep"]) == 0
+        # capacity returns AFTER settle
+        await plane.spawn_host("h5")
+        await controller.health_tick()
+        assert len(app.replicas["rec_dep"]) == 2
+        handle = controller.get_handle("rec-app")
+        r = await handle.call("add", 1, 2)
+        assert r["sum"] == 3
+
+    async def test_double_restart_recovers_from_recovering_snapshot(
+        self, plane
+    ):
+        """Reconcile edge: the controller crashes AGAIN mid-recovery.
+        recover() compacts a snapshot flagged recovering=True before
+        reconcile settles; the third life must recover the same intent
+        from that snapshot."""
+        h1 = await plane.spawn_host("h1")
+        await plane.deploy()
+        await plane.crash()
+        # keep the host away so the second life CANNOT settle
+        h1.rejoin = False
+        h1.connection.auto_reconnect = False
+        h1.connection._closing = True
+        await h1.connection._abort_connection()
+        second = await plane.restart(grace_s=60.0)
+        assert second.phase == "RECOVERING"
+        snap = second.journal._read_snapshot()
+        assert snap["recovering"] is True
+        assert "rec-app" in snap["apps"]
+        # second crash, mid-recovery
+        await plane.crash()
+        third = await plane.restart(grace_s=1.5)
+        assert third.epoch == 3
+        assert third.phase == "RECOVERING"
+        assert "rec-app" in third.apps
+        spec = third.apps["rec-app"].specs["rec_dep"]
+        assert spec.num_replicas == 2 and spec.chips_per_replica == 2
+        await plane.spawn_host("h4")
+        await plane.settle()
+        assert third.apps["rec-app"].status == "RUNNING"
+        assert len(third.apps["rec-app"].replicas["rec_dep"]) == 2
+
+    async def test_undeploy_and_scale_survive_restart(self, plane):
+        """The journal replays undeploy and autoscale intent: an app
+        undeployed before the crash must NOT be resurrected."""
+        await plane.spawn_host("h1")
+        await plane.deploy()
+        await plane.controller.undeploy("rec-app")
+        await plane.crash()
+        controller = await plane.restart(grace_s=1.0)
+        assert controller.phase == "ACTIVE"
+        assert "rec-app" not in controller.apps
+
+
+class TestOrphanMode:
+    async def test_orphan_grace_self_drain(self, plane):
+        """The orphaned-host gap: controller gone and never coming
+        back → after BIOENGINE_ORPHAN_GRACE_S the host drains and
+        stops its replicas (chips stop serving unowned intent), with
+        the host.orphaned / host.orphan_drain evidence pair."""
+        t0 = time.time()
+        h1 = await plane.spawn_host("h1", orphan_grace_s=0.6)
+        await plane.deploy()
+        served = dict(h1.replicas)
+        assert served
+        await plane.crash()
+        deadline = time.monotonic() + 8
+        while not h1.orphan_drained and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        assert h1.orphan_drained
+        assert h1.replicas == {}
+        for r in served.values():
+            assert r.state.value == "STOPPED"
+        types = [
+            e["type"]
+            for e in flight.get_events(
+                types=("host.orphaned", "host.orphan_drain"), since=t0
+            )
+        ]
+        assert types.count("host.orphaned") == 1
+        assert types.count("host.orphan_drain") == 1
+
+    async def test_rejoin_within_grace_keeps_replicas(self, plane):
+        """The pair event: a host that rejoins inside the grace window
+        keeps serving its warm replicas and stamps the rejoin with the
+        epoch it came back under."""
+        t0 = time.time()
+        h1 = await plane.spawn_host("h1", orphan_grace_s=30.0)
+        await plane.deploy()
+        instances = {rid: id(r.instance) for rid, r in h1.replicas.items()}
+        await plane.crash()
+        await asyncio.sleep(0.1)
+        assert h1._orphaned_since is not None
+        await plane.restart(grace_s=4.0)
+        await plane.settle()
+        assert h1._orphaned_since is None      # watchdog disarmed
+        assert not h1.orphan_drained
+        assert {rid: id(r.instance) for rid, r in h1.replicas.items()} == (
+            instances
+        )
+        rejoined = flight.get_events(
+            types=("host.rejoined_epoch",), since=t0
+        )
+        assert rejoined
+        attrs = rejoined[-1]["attrs"]
+        assert attrs["epoch"] == plane.controller.epoch
+        assert attrs["orphan_gap_s"] > 0
+
+
+class TestEpochFencing:
+    async def test_check_epoch_ratchet_and_reject(self, tmp_path):
+        host = WorkerHost(
+            server_url="ws://127.0.0.1:1/ws", host_id="fence-h",
+            workspace_dir=tmp_path, orphan_grace_s=0,
+        )
+        host._check_epoch(None, "start_replica")   # legacy: accepted
+        assert host.controller_epoch == 0
+        host._check_epoch(3, "start_replica")
+        assert host.controller_epoch == 3
+        host._check_epoch(3, "drain_replica")      # equal: fine
+        with pytest.raises(StaleEpochError) as exc:
+            host._check_epoch(2, "drain_replica")
+        assert exc.value.seen_epoch == 3
+        assert exc.value.got_epoch == 2
+        # classified APPLICATION (terminal), never failed over
+        from bioengine_tpu.serving.errors import (
+            FailureKind,
+            classify_exception,
+        )
+
+        assert classify_exception(exc.value) is FailureKind.APPLICATION
+
+    async def test_controller_stamps_epoch_on_host_verbs(self, plane):
+        await plane.spawn_host("h1")
+        seen = {}
+        orig = plane.server.call_service_method
+
+        async def spy(full_id, method, args=(), kwargs=None, **kw):
+            if method in ("start_replica", "drain_replica", "stop_replica"):
+                seen[method] = (kwargs or {}).get("epoch")
+            return await orig(full_id, method, args, kwargs, **kw)
+
+        plane.server.call_service_method = spy
+        await plane.deploy()
+        await plane.controller.undeploy("rec-app")
+        assert seen["start_replica"] == plane.controller.epoch
+        assert seen["stop_replica"] == plane.controller.epoch
+
+    async def test_epoch_not_stamped_on_pre_epoch1_host(self, tmp_path):
+        """Mixed-version fleet: a host that never declared the
+        ``epoch1`` capability gets the LEGACY verb signature. Stamping
+        the kwarg unconditionally would TypeError every placement on
+        un-upgraded hosts the moment the controller is upgraded first
+        in a rolling deploy."""
+        calls = []
+
+        class FakeRpc:
+            def __init__(self, supports):
+                self.supports = supports
+
+            def service_peer_supports(self, service_id, capability):
+                return self.supports
+
+            async def call_service_method(
+                self, service_id, method, args=(), kwargs=None, **kw
+            ):
+                calls.append((method, dict(kwargs or {})))
+                return {}
+
+        c = ServeController(
+            _no_local_chips(), health_check_period=3600,
+            control_dir=str(tmp_path / "control"),
+        )
+        c._rpc_server = FakeRpc(False)
+        await c._call_host("svc", "start_replica", "rid")
+        assert "epoch" not in calls[-1][1]
+
+        c._rpc_server = FakeRpc(True)
+        await c._call_host("svc", "start_replica", "rid")
+        assert calls[-1][1]["epoch"] == c.epoch
+
+
+class TestMeshRecovery:
+    async def test_mesh_shards_reattach_to_rebuilt_mesh(self, plane):
+        """Tentpole mesh leg: a 2-host pipeline mesh survives the
+        controller restart — both shard hosts rejoin reporting their
+        ``mesh_shard`` inventory, the controller rebuilds ONE
+        MeshReplica around them (same mesh id, chips re-leased under
+        it, shard instances untouched) and serving output parity
+        holds."""
+        import numpy as np
+        from test_mesh import (
+            MESH_MANIFEST,
+            _write_mesh_app,
+            make_input,
+            reference_forward,
+        )
+
+        h1 = await plane.spawn_host("h1")
+        h2 = await plane.spawn_host("h2")
+        builder = AppBuilder(workdir_root=plane.tmp_path / "apps")
+        built = builder.build(
+            app_id="mesh-app",
+            local_path=_write_mesh_app(plane.tmp_path, MESH_MANIFEST),
+        )
+        await plane.controller.deploy("mesh-app", built.specs)
+        mesh = plane.controller.apps["mesh-app"].replicas["mesh_dep"][0]
+        mesh_rid = mesh.replica_id
+        assert mesh.plan.cross_host
+        shard_instances = {
+            rid: id(r.instance)
+            for host in (h1, h2)
+            for rid, r in host.replicas.items()
+        }
+        assert len(shard_instances) == 2
+
+        await plane.crash()
+        await asyncio.sleep(0.15)
+        controller = await plane.restart(grace_s=6.0)
+        await plane.settle()
+
+        replicas = controller.apps["mesh-app"].replicas["mesh_dep"]
+        assert len(replicas) == 1
+        rebuilt = replicas[0]
+        assert rebuilt.replica_id == mesh_rid
+        assert rebuilt is not mesh            # a NEW controller-side object
+        assert sorted(rebuilt.plan.hosts) == ["h1", "h2"]
+        report = controller.reconcile_report
+        assert report["mesh_rebuilt"] == 1
+        assert report["replaced"] == 0
+        # shard chips re-leased under the mesh id, shard instances kept
+        for host_id in ("h1", "h2"):
+            rec = controller.cluster_state.hosts[host_id]
+            assert list(rec.chips_in_use.values()) == [mesh_rid] * 2
+        for host in (h1, h2):
+            for rid, r in host.replicas.items():
+                assert id(r.instance) == shard_instances[rid]
+
+        x = make_input()
+        handle = controller.get_handle("mesh-app", "mesh_dep")
+        out = np.asarray(await handle.call("predict", x))
+        np.testing.assert_allclose(
+            out, reference_forward(x), rtol=1e-4, atol=1e-5
+        )
+
+
+class TestSurplusMeshSweep:
+    async def test_surplus_complete_mesh_swept_at_settle(self, tmp_path):
+        """Intent says ONE mesh but TWO complete warm meshes report at
+        recovery (the old controller died between planning a
+        replacement and stopping the degraded original). The second
+        mesh's early stages were answered "kept" before the surplus
+        was knowable — the settle sweep must stop them host-side, not
+        leave them serving unrouted on leased chips forever."""
+        control = tmp_path / "control"
+        spec = DeploymentSpec(
+            name="dep", instance_factory=lambda: None,
+            num_replicas=1, min_replicas=1, chips_per_replica=2,
+            autoscale=False, mesh=MeshConfig(stages=2),
+        )
+        seed = ControlJournal(control)
+        seed.mint_epoch()
+        seed.append(
+            "deploy",
+            {"app_id": "m-app", "specs": [spec_to_dict(spec)],
+             "acl": None},
+        )
+        controller = ServeController(
+            _no_local_chips(), health_check_period=3600,
+            control_dir=str(control),
+        )
+        stops = []
+
+        async def fake_call_host(service_id, verb, *args, **kwargs):
+            stops.append((service_id, verb, args))
+            return {}
+
+        controller._call_host = fake_call_host
+        await controller.recover()
+        assert controller.phase == "RECOVERING"
+        for n in range(1, 5):
+            controller.cluster_state.register_host(
+                f"fh{n}", f"svc-fh{n}", {"n_chips": 2}
+            )
+
+        def report(mesh_rid, stage, host_n):
+            return controller._adopt_reported_replica(
+                f"fh{host_n}", f"svc-fh{host_n}",
+                {
+                    "app_id": "m-app", "deployment": "dep",
+                    "replica_id": f"{mesh_rid}-s{stage}",
+                    "state": "healthy",
+                    "device_ids": [0, 1],
+                    "mesh_shard": {
+                        "mesh_replica_id": mesh_rid, "stage": stage,
+                    },
+                },
+            )
+
+        # mesh A completes first and satisfies the intent
+        assert report("meshA", 0, 1)
+        assert report("meshA", 1, 2)
+        assert len(controller.apps["m-app"].replicas["dep"]) == 1
+        # mesh B: stage 0 is answered "kept" (siblings may complete
+        # it); stage 1 reveals the surplus and is told to drop
+        assert report("meshB", 0, 3)
+        assert not report("meshB", 1, 4)
+        assert "meshB" in controller._surplus_mesh_shards
+        await controller._reconcile_settle()
+        # the already-kept stage-0 shard was stopped host-side
+        assert ("svc-fh3", "stop_replica", ("meshB-s0",)) in stops
+        assert controller._surplus_mesh_shards == {}
+        assert controller.reconcile_report["dropped"] == 1
+        assert controller.reconcile_report["mesh_rebuilt"] == 1
+
+
+class TestReReportRelease:
+    """A re-registering host gets a FRESH HostRecord (empty lease
+    table): every "keep your replica" answer during recovery must
+    re-establish the chip lease, or the ledger shows the devices free
+    and a later placement double-leases them."""
+
+    def _recovered_controller(self, tmp_path, spec):
+        control = tmp_path / "control"
+        seed = ControlJournal(control)
+        seed.mint_epoch()
+        seed.append(
+            "deploy",
+            {"app_id": "rr-app", "specs": [spec_to_dict(spec)],
+             "acl": None},
+        )
+        return ServeController(
+            _no_local_chips(), health_check_period=3600,
+            control_dir=str(control),
+        )
+
+    async def test_rebuilt_mesh_shard_rereport_releases_chips(
+        self, tmp_path
+    ):
+        spec = DeploymentSpec(
+            name="dep", instance_factory=lambda: None,
+            num_replicas=1, min_replicas=1, chips_per_replica=2,
+            autoscale=False, mesh=MeshConfig(stages=2),
+        )
+        controller = self._recovered_controller(tmp_path, spec)
+
+        async def fake_call_host(*a, **k):
+            return {}
+
+        controller._call_host = fake_call_host
+        await controller.recover()
+        for n in (1, 2):
+            controller.cluster_state.register_host(
+                f"fh{n}", f"svc-fh{n}", {"n_chips": 2}
+            )
+
+        def report(stage, host_n):
+            return controller._adopt_reported_replica(
+                f"fh{host_n}", f"svc-fh{host_n}",
+                {
+                    "app_id": "rr-app", "deployment": "dep",
+                    "replica_id": f"meshA-s{stage}",
+                    "state": "healthy", "device_ids": [0, 1],
+                    "mesh_shard": {
+                        "mesh_replica_id": "meshA", "stage": stage,
+                    },
+                },
+            )
+
+        assert report(0, 1) and report(1, 2)   # mesh rebuilt
+        # host fh1 blips and re-registers: fresh record, empty leases
+        controller.cluster_state.register_host(
+            "fh1", "svc-fh1", {"n_chips": 2}
+        )
+        assert controller.cluster_state.hosts["fh1"].chips_in_use == {}
+        # the re-report is kept AND the lease is restored
+        assert report(0, 1)
+        assert controller.cluster_state.hosts["fh1"].chips_in_use == {
+            0: "meshA", 1: "meshA",
+        }
+
+    async def test_replica_rereport_releases_chips(self, tmp_path):
+        spec = DeploymentSpec(
+            name="dep", instance_factory=lambda: None,
+            num_replicas=1, min_replicas=1, chips_per_replica=2,
+            autoscale=False,
+            remote_payload={"app_id": "rr-app", "deployment": "dep",
+                            "files": {}},
+        )
+        controller = self._recovered_controller(tmp_path, spec)
+        await controller.recover()
+        controller.cluster_state.register_host(
+            "fh1", "svc-fh1", {"n_chips": 2}
+        )
+        info = {
+            "app_id": "rr-app", "deployment": "dep",
+            "replica_id": "rep-1", "state": "HEALTHY",
+            "device_ids": [0, 1],
+        }
+        assert controller._adopt_reported_replica("fh1", "svc-fh1", info)
+        # blip re-register: fresh record, empty leases
+        controller.cluster_state.register_host(
+            "fh1", "svc-fh1", {"n_chips": 2}
+        )
+        assert controller._adopt_reported_replica("fh1", "svc-fh1", info)
+        assert controller.cluster_state.hosts["fh1"].chips_in_use == {
+            0: "rep-1", 1: "rep-1",
+        }
+        # the same replica id reported by a DIFFERENT host is dropped
+        controller.cluster_state.register_host(
+            "fh9", "svc-fh9", {"n_chips": 2}
+        )
+        assert not controller._adopt_reported_replica(
+            "fh9", "svc-fh9", info
+        )
+
+
+class TestJournalCli:
+    def test_debug_journal_offline_dump_redacts_tokens(self, tmp_path):
+        """``bioengine debug journal`` reads a (dead) controller's
+        directory with no server and masks secret-shaped payload
+        values — the runbook's second read after the epoch."""
+        from click.testing import CliRunner
+
+        from bioengine_tpu.cli.cli import main as cli_main
+
+        j = ControlJournal(tmp_path, snapshot_every=1000)
+        j.mint_epoch()
+        j.append(
+            "deploy",
+            {
+                "app_id": "demo",
+                "specs": [
+                    {
+                        "name": "dep",
+                        "num_replicas": 2,
+                        "remote_payload": {
+                            "env_vars": {"API_TOKEN": "sup3rsecret"},
+                            "files": {"m.py": "sourcecode here"},
+                        },
+                    }
+                ],
+                "acl": None,
+            },
+        )
+        result = CliRunner().invoke(
+            cli_main, ["debug", "journal", "--dir", str(tmp_path)]
+        )
+        assert result.exit_code == 0, result.output
+        assert "demo" in result.output
+        assert "sup3rsecret" not in result.output
+        assert "sourcecode" not in result.output
+        assert "***redacted***" in result.output
+
+    def test_debug_journal_missing_dir_errors(self):
+        from click.testing import CliRunner
+
+        from bioengine_tpu.cli.cli import main as cli_main
+
+        result = CliRunner().invoke(
+            cli_main, ["debug", "journal", "--dir", "/nonexistent-xyz"]
+        )
+        assert result.exit_code != 0
+
+
+class TestManagerRecoveryAdoption:
+    async def test_record_recovery_reattaches_to_journaled_intent(
+        self, plane
+    ):
+        """Worker-restart collision: the control journal AND the apps
+        manager's record file cover the SAME app. Life 2's record
+        recovery must re-attach the rebuilt app to the journal-
+        recovered controller intent — live instance factories swapped
+        in, service proxy registered, record kept — instead of dying
+        on 'already deployed' and silently dropping the app from the
+        state file."""
+        from bioengine_tpu.apps.manager import AppsManager
+        from bioengine_tpu.serving.journal import PayloadInstanceFactory
+        from bioengine_tpu.utils.permissions import create_context
+
+        admin = create_context("admin")
+        state_file = plane.tmp_path / "deployed.json"
+        app_dir = _write_rec_app(plane.tmp_path)
+        await plane.spawn_host("h1")
+        manager1 = AppsManager(
+            controller=plane.controller, server=plane.server,
+            builder=AppBuilder(workdir_root=plane.tmp_path / "apps"),
+            admin_users=["admin"], state_file=state_file,
+            can_scale_out=True,   # capacity comes from joined hosts
+        )
+        await manager1.deploy_app(
+            local_path=str(app_dir), app_id="rec-app", context=admin
+        )
+        assert "rec-app" in manager1.records
+
+        await plane.crash()
+        await asyncio.sleep(0.15)
+        controller2 = await plane.restart(grace_s=6.0)
+        manager2 = AppsManager(
+            controller=controller2, server=plane.server,
+            builder=AppBuilder(workdir_root=plane.tmp_path / "apps2"),
+            admin_users=["admin"], state_file=state_file,
+        )
+        recovered = await manager2.recover_deployed_applications()
+        assert len(recovered) == 1     # no 'already deployed' collision
+        assert "rec-app" in manager2.records
+        app = controller2.apps["rec-app"]
+        # reconcile still owns the app — record recovery did not
+        # short-circuit the RECOVERING phase
+        assert app.status == "RECOVERING"
+        # the rebuilt specs' LIVE factories replaced the payload stubs
+        spec = app.specs["rec_dep"]
+        assert not isinstance(spec.instance_factory, PayloadInstanceFactory)
+        await plane.settle()
+        # the rejoined host's warm replicas were adopted, and the app
+        # serves through the re-registered service proxy
+        assert len(app.replicas["rec_dep"]) == 2
+        out = await plane.server.call_service_method(
+            recovered[0]["service_id"], "add",
+            kwargs={"a": 2, "b": 3},
+            caller=plane.server.validate_token(
+                plane.server.issue_token("anyone")
+            ),
+        )
+        assert out["sum"] == 5
+
+
+class TestWorkerStartRecovers:
+    async def test_production_worker_start_replays_journal(
+        self, tmp_path, monkeypatch
+    ):
+        """The PRODUCTION startup path recovers: BioEngineWorker.start
+        with ``BIOENGINE_CONTROL_DIR`` set must replay the previous
+        life's journaled intent into the RECOVERING phase before the
+        router verbs exist — not just the test harnesses that call
+        ``recover()`` by hand."""
+        from bioengine_tpu.worker.worker import BioEngineWorker
+
+        control_dir = tmp_path / "control"
+        seed = ControlJournal(control_dir)
+        seed.mint_epoch()
+        spec = DeploymentSpec(
+            name="dep", instance_factory=lambda: None, num_replicas=1
+        )
+        seed.append(
+            "deploy",
+            {
+                "app_id": "ghost-app",
+                "specs": [spec_to_dict(spec)],
+                "acl": None,
+            },
+        )
+        monkeypatch.setenv("BIOENGINE_CONTROL_DIR", str(control_dir))
+        w = BioEngineWorker(
+            mode="single-machine",
+            workspace_dir=tmp_path / "ws",
+            admin_users=["admin"],
+            log_file="off",
+        )
+        await w.start()
+        try:
+            assert "ghost-app" in w.controller.apps
+            assert w.controller.apps["ghost-app"].status == "RECOVERING"
+            assert w.controller.phase == "RECOVERING"
+            # the second life out-epochs the seed life's epoch 1
+            assert w.controller.epoch == 2
+        finally:
+            await w.stop()
+
+
+# ---------------------------------------------------------------------------
+# real subprocess: an actual controller process SIGKILLed + restarted
+# ---------------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def _wait_marker(proc, marker: str, timeout: float = 60.0) -> str:
+    deadline = time.monotonic() + timeout
+    while True:
+        remaining = deadline - time.monotonic()
+        assert remaining > 0, f"'{marker}' never printed"
+        line = await asyncio.wait_for(
+            proc.stdout.readline(), timeout=remaining
+        )
+        assert line, f"controller proc exited before '{marker}'"
+        text = line.decode().strip()
+        if text.startswith(marker):
+            return text
+
+
+@pytest.mark.slow
+class TestRealSubprocessCrash:
+    async def test_kill_and_restart_real_controller_process(self, tmp_path):
+        """An ACTUAL controller process (RpcServer + journaled
+        ServeController) is SIGKILLed and restarted on the same port +
+        journal dir; the in-test worker host rides through orphaned,
+        rejoins the second life, and its replica is re-adopted without
+        a restart."""
+        port = _free_port()
+        control_dir = tmp_path / "control"
+        app_dir = _write_rec_app(tmp_path)
+        token = "subproc-admin-token"
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "BIOENGINE_ADMIN_TOKEN": token,
+            "BIOENGINE_RECONCILE_GRACE_S": "10",
+        }
+
+        async def spawn(extra):
+            return await asyncio.create_subprocess_exec(
+                sys.executable, "-m",
+                "bioengine_tpu.testing.controller_proc",
+                "--port", str(port), "--control-dir", str(control_dir),
+                *extra,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.DEVNULL,
+                env=env,
+            )
+
+        proc1 = await spawn(
+            ["--deploy-dir", str(app_dir), "--app-id", "rec-app"]
+        )
+        host = None
+        proc2 = None
+        try:
+            ready = await _wait_marker(proc1, "READY")
+            assert "epoch=1" in ready
+            host = WorkerHost(
+                server_url=f"ws://127.0.0.1:{port}/ws",
+                token=token,
+                host_id="sub-h1",
+                workspace_dir=tmp_path / "ws-sub-h1",
+                rejoin=True,
+                orphan_grace_s=120.0,
+            )
+            await host.start()
+            host.connection.reconnect_max_backoff_s = 0.3
+            await _wait_marker(proc1, "DEPLOYED")
+            assert len(host.replicas) == 2
+            instances = {
+                rid: id(r.instance) for rid, r in host.replicas.items()
+            }
+
+            client = await connect_to_server(
+                {"server_url": f"ws://127.0.0.1:{port}/ws", "token": token}
+            )
+            r = await client.call(
+                "serve-router", "route_call", "rec-app", "rec_dep",
+                "add", [2, 3], {},
+            )
+            assert r["sum"] == 5
+            await client.disconnect()
+
+            # SIGKILL the real process mid-life
+            proc1.send_signal(signal.SIGKILL)
+            await proc1.wait()
+            deadline = time.monotonic() + 10
+            while host._orphaned_since is None and (
+                time.monotonic() < deadline
+            ):
+                await asyncio.sleep(0.05)
+            assert host._orphaned_since is not None
+
+            proc2 = await spawn(["--recover"])
+            ready2 = await _wait_marker(proc2, "READY")
+            assert "epoch=2" in ready2 and "phase=RECOVERING" in ready2
+            reconciled = await _wait_marker(proc2, "RECONCILED")
+            assert "adopted=2" in reconciled
+            assert "replaced=0" in reconciled
+
+            # the host kept its instances (no restart) and serves
+            # under the new epoch
+            assert {
+                rid: id(r.instance) for rid, r in host.replicas.items()
+            } == instances
+            deadline = time.monotonic() + 10
+            while host.controller_epoch < 2 and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            assert host.controller_epoch == 2
+
+            client = await connect_to_server(
+                {"server_url": f"ws://127.0.0.1:{port}/ws", "token": token}
+            )
+            r = await client.call(
+                "serve-router", "route_call", "rec-app", "rec_dep",
+                "add", [40, 2], {},
+            )
+            assert r["sum"] == 42
+            await client.disconnect()
+        finally:
+            if host is not None:
+                await host.stop()
+            for proc in (proc1, proc2):
+                if proc is not None and proc.returncode is None:
+                    proc.kill()
+                    await proc.wait()

@@ -154,6 +154,7 @@ class TestScenarioVocabulary:
             "blip_storm",
             "hot_signature",
             "tenant_flood",
+            "controller_crash",
         } <= names
         assert len(names) >= 5
         with pytest.raises(KeyError, match="unknown scenario"):
@@ -235,6 +236,27 @@ class TestScenarioRuns:
                 if v["required"] and not v["ok"]
             }
             assert result["passed"], (name, failed)
+
+    async def test_controller_crash_recovers_with_zero_loss(self):
+        """PR 15 acceptance: the controller is SIGKILL-equivalently
+        torn down mid-mixed-priority traffic, restarted against the
+        same journal, and reconciles — zero failed idempotent
+        requests, every surviving replica adopted (no re-placement,
+        no duplicates), chip accounting exact, and the revived old
+        controller's lower-epoch verb fenced. Deterministic across two
+        runs for one seed (the CI double-run gate)."""
+        scenario = get_scenario("controller_crash")
+        r1 = await run_scenario_async(scenario, seed=7)
+        inv = r1["invariants"]
+        assert inv["zero_failed_idempotent"]["ok"], inv
+        assert inv["chip_accounting_exact"]["ok"], inv
+        assert inv["no_duplicate_placements"]["ok"], inv
+        assert inv["replicas_adopted"]["ok"], inv
+        assert inv["epoch_fencing_observed"]["ok"], inv
+        assert r1["passed"], inv
+        assert r1["counts"] == {"ok": r1["requests"]}
+        r2 = await run_scenario_async(scenario, seed=7)
+        assert outcome_signature(r1) == outcome_signature(r2)
 
     async def test_tenant_flood_protects_the_strict_tenant(self):
         result = await run_scenario_async(
