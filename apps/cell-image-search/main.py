@@ -203,6 +203,10 @@ class CellImageSearch:
         live = self._sessions.get(session_id)
         if live is not None and not live.done():
             raise RuntimeError(f"session '{session_id}' already running")
+        # prune finished task handles so the registry tracks only live
+        # runs — session history lives on disk (status.json), not here
+        for sid in [s for s, t in self._sessions.items() if t.done()]:
+            self._sessions.pop(sid, None)
         # fresh session dir per run
         sdir = session_dir(self.workspace_dir, session_id)
         if sdir.exists():

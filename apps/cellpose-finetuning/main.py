@@ -850,6 +850,10 @@ class CellposeFinetune:
             ),
         )
         if arch_key not in self._fwd_cache:
+            # compiled-forward memo: bounded by distinct architecture
+            # tuples, and evicting on session delete would retrigger an
+            # XLA compile for siblings sharing the arch
+            # bioengine: ignore[BE-LIFE-401]
             self._fwd_cache[arch_key] = jax.jit(
                 lambda p, a, m=model: m.apply({"params": p}, a)
             )

@@ -56,6 +56,8 @@ from bioengine_tpu.analysis import jax_rules as _jax_rules  # noqa: F401
 from bioengine_tpu.analysis import obs_rules as _obs_rules  # noqa: F401
 from bioengine_tpu.analysis import dist_rules as _dist_rules  # noqa: F401
 from bioengine_tpu.analysis import interproc as _interproc  # noqa: F401
+from bioengine_tpu.analysis import hotpath_rules as _hotpath_rules  # noqa: F401
+from bioengine_tpu.analysis import lifecycle_rules as _lifecycle_rules  # noqa: F401
 
 from bioengine_tpu.analysis.project import (
     analyze_project,

@@ -150,6 +150,22 @@ def build_parser() -> argparse.ArgumentParser:
         "to stderr",
     )
     p.add_argument(
+        "--stats-json",
+        type=Path,
+        default=None,
+        metavar="FILE",
+        help="write machine-readable run stats (wall, cache hits, "
+        "per-pass timings) as JSON — the CI perf-budget probe",
+    )
+    p.add_argument(
+        "--hot-path-report",
+        type=Path,
+        default=None,
+        metavar="FILE",
+        help="write the BE-PERF-3xx hot-path overhead map (reachable "
+        "functions ranked by finding count x call-graph depth) as JSON",
+    )
+    p.add_argument(
         "--list-rules",
         action="store_true",
         help="print the rule catalog and exit",
@@ -211,15 +227,33 @@ def main(argv: list[str] | None = None) -> int:
     rules = set(args.rule) if args.rule else None
     cache_path = None if args.no_cache else args.cache
     t0 = time.monotonic()
-    findings, stats = analyze_project(
+    findings, stats, ctx = analyze_project(
         scan_paths,
         root=Path.cwd(),
         report_paths=report_paths,
         rules=rules,
         jobs=args.jobs,
         cache_path=cache_path,
+        return_context=True,
     )
     wall_s = time.monotonic() - t0
+
+    if args.hot_path_report is not None:
+        from bioengine_tpu.analysis.hotpath_rules import (
+            build_hot_path_report,
+        )
+
+        report = build_hot_path_report(ctx)
+        args.hot_path_report.write_text(
+            json.dumps(report, indent=2) + "\n", encoding="utf-8"
+        )
+        print(
+            f"analyze: hot-path report -> {args.hot_path_report} "
+            f"({report['totals']['roots']} roots, "
+            f"{report['totals']['reachable_functions']} reachable, "
+            f"{report['totals']['findings']} finding(s))",
+            file=sys.stderr,
+        )
 
     if args.stats:
         print(
@@ -228,6 +262,26 @@ def main(argv: list[str] | None = None) -> int:
             f"cache, jobs={stats.jobs}) — index {stats.wall_s:.2f}s, "
             f"total {wall_s:.2f}s",
             file=sys.stderr,
+        )
+
+    if args.stats_json is not None:
+        args.stats_json.write_text(
+            json.dumps(
+                {
+                    "schema": "bioengine.analyze-stats/v1",
+                    "wall_s": round(wall_s, 4),
+                    "index_wall_s": round(stats.wall_s, 4),
+                    "files_total": stats.files_total,
+                    "files_indexed": stats.files_indexed,
+                    "files_cached": stats.files_cached,
+                    "jobs": stats.jobs,
+                    "passes": stats.pass_s,
+                    "findings": len(findings),
+                },
+                indent=2,
+            )
+            + "\n",
+            encoding="utf-8",
         )
 
     baseline_path = args.baseline or DEFAULT_BASELINE

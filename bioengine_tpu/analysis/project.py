@@ -46,8 +46,14 @@ from bioengine_tpu.analysis.core import (
     run_module_passes,
 )
 
-CACHE_VERSION = 4
+CACHE_VERSION = 5
 DEFAULT_CACHE = Path(".analyze-cache.json")
+
+# `# analyze: hot-path-root` on a def line (or the line directly above
+# it) declares the function a request-path root for the BE-PERF-3xx
+# hot-path cost pass, extending the checked-in catalog in
+# hotpath_rules.HOT_PATH_ROOT_CATALOG.
+_HOT_PATH_ROOT_RE = re.compile(r"#\s*analyze:\s*hot-path-root\b")
 
 # ---------------------------------------------------------------------------
 # Blocking-call model shared with the interprocedural async pass
@@ -84,6 +90,8 @@ _ASYNC_LOCKS = {
     "asyncio.BoundedSemaphore",
     "asyncio.Condition",
 }
+
+_CONSTRUCTOR_NAMES = {"__init__", "__post_init__", "__new__"}
 
 # verbs ride these call shapes (see rpc/client.py, serving/controller.py):
 #   <conn>.call("service-id", "verb", ...)          both strings constant
@@ -138,6 +146,8 @@ class _FunctionFacts:
     __slots__ = (
         "qualname", "lineno", "is_async", "is_generator", "cls",
         "calls", "blocking", "writes", "withs", "acquires",
+        "perf", "map_inserts", "map_sweeps", "task_spawns",
+        "task_cancels", "sem_acquires", "sem_releases",
     )
 
     def __init__(self, qualname: str, lineno: int, is_async: bool,
@@ -154,6 +164,17 @@ class _FunctionFacts:
         self.writes: list[list] = []     # [attr, line, col, locked]
         self.withs: list[list] = []      # [ref, line, col, is_async, has_await]
         self.acquires: list[list] = []   # [ref, line, col]
+        # per-request cost sites for the BE-PERF-3xx hot-path pass
+        self.perf: list[list] = []       # [kind, detail, line, col]
+        # keyed-map lifecycle sites for BE-LIFE-401
+        self.map_inserts: list[list] = []  # [attr, line, col]
+        self.map_sweeps: list[list] = []   # [attr, line, col]
+        # supervised-task handle sites for BE-LIFE-402
+        self.task_spawns: list[list] = []  # [attr, line, col]
+        self.task_cancels: list[list] = []  # [attr, line, col]
+        # semaphore/lock pairing sites for BE-LIFE-403
+        self.sem_acquires: list[list] = []  # [base, line, col, protected]
+        self.sem_releases: list[list] = []  # [base, line, col, in_finally]
 
     def to_dict(self) -> dict:
         return {
@@ -167,6 +188,13 @@ class _FunctionFacts:
             "writes": self.writes,
             "withs": self.withs,
             "acquires": self.acquires,
+            "perf": self.perf,
+            "map_inserts": self.map_inserts,
+            "map_sweeps": self.map_sweeps,
+            "task_spawns": self.task_spawns,
+            "task_cancels": self.task_cancels,
+            "sem_acquires": self.sem_acquires,
+            "sem_releases": self.sem_releases,
         }
 
 
@@ -212,9 +240,30 @@ class _Indexer(ast.NodeVisitor):
         self.caps_offered: list[list] = []       # [symbol|value, line, col]
         self.caps_gated: list[list] = []         # [symbol|value, line, col]
 
+        # `self.X = {}` / dict() / defaultdict(...) sites per class —
+        # BE-LIFE-401 only considers attrs declared mapping-shaped, so
+        # list/array index assignment never reads as a keyed insert
+        self.dict_attrs: list[list] = []         # [cls, attr, line, col]
+
         self._class_stack: list[str] = []
         self._fn_stack: list[_FunctionFacts] = []
         self._lock_depth = 0
+        # depth > 0: inside the miss branch of an `if x is None:`
+        # memoization guard — an env read there is a cached read, not a
+        # per-request cost (metrics_enabled, tracing._cached_env, ...)
+        self._memo_depth = 0
+        # depth > 0: inside `if log.isEnabledFor(...)`-guarded code —
+        # eager formatting there is level-gated, not a per-request cost
+        self._log_guard_depth = 0
+        # stack of lock/semaphore bases released in the finally block of
+        # each enclosing `try:` — an acquire under one of these is
+        # exception-safe (BE-LIFE-403)
+        self._finally_release_stack: list[set[str]] = []
+        self._in_finally = 0
+        # local-name -> self-attr aliases per function frame, so
+        # `task = self._t` / `if task: task.cancel()` still counts as a
+        # cancel of `self._t` (the common guarded-cancel idiom)
+        self._alias_stack: list[dict[str, str]] = [{}]
         self._module_fn = _FunctionFacts("<module>", 1, False, None)
         self.functions["<module>"] = self._module_fn
 
@@ -237,6 +286,9 @@ class _Indexer(ast.NodeVisitor):
 
     def visit_Import(self, node: ast.Import) -> None:
         for alias in node.names:
+            # _Indexer is a per-parse throwaway (and visit_Delete is an
+            # AST hook, not a close path)
+            # bioengine: ignore[BE-LIFE-401]
             self.imports[alias.asname or alias.name.split(".")[0]] = (
                 alias.name
             )
@@ -263,13 +315,27 @@ class _Indexer(ast.NodeVisitor):
             # nested sync def's blocking calls don't taint the parent
             qual = f"{self._fn.qualname}.<locals>.{node.name}"
         facts = _FunctionFacts(qual, node.lineno, is_async, cls)
-        # first definition wins (overloads / branches are rare)
+        # first definition wins (overloads / branches are rare);
+        # per-parse throwaway registry # bioengine: ignore[BE-LIFE-401]
         self.functions.setdefault(qual, facts)
         self._fn_stack.append(facts)
+        self._alias_stack.append({})
         saved_lock = self._lock_depth
+        saved_memo = self._memo_depth
+        saved_guard = self._log_guard_depth
+        saved_finally = self._finally_release_stack
         self._lock_depth = 0
+        self._memo_depth = 0
+        self._log_guard_depth = 0
+        # an enclosing try's finally does not run around a nested def's
+        # body — the nested function executes later, elsewhere
+        self._finally_release_stack = []
         self.generic_visit(node)
         self._lock_depth = saved_lock
+        self._memo_depth = saved_memo
+        self._log_guard_depth = saved_guard
+        self._finally_release_stack = saved_finally
+        self._alias_stack.pop()
         self._fn_stack.pop()
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
@@ -325,6 +391,68 @@ class _Indexer(ast.NodeVisitor):
     def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
         self._visit_with(node, True)
 
+    # ---- guard-sensitive blocks (memoization / log level) -----------
+
+    @staticmethod
+    def _is_memo_test(test: ast.AST) -> bool:
+        """``if x is None:`` (incl. walrus) — the miss branch of the
+        read-once memoization idiom."""
+        return (
+            isinstance(test, ast.Compare)
+            and len(test.ops) == 1
+            and isinstance(test.ops[0], ast.Is)
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None
+        )
+
+    @staticmethod
+    def _is_log_guard_test(test: ast.AST) -> bool:
+        for sub in ast.walk(test):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "isEnabledFor"
+            ):
+                return True
+        return False
+
+    def visit_If(self, node: ast.If) -> None:
+        memo = self._is_memo_test(node.test)
+        guard = self._is_log_guard_test(node.test)
+        self.visit(node.test)
+        self._memo_depth += memo
+        self._log_guard_depth += guard
+        for stmt in node.body:
+            self.visit(stmt)
+        self._memo_depth -= memo
+        self._log_guard_depth -= guard
+        # the else branch is the memo HIT path / the unguarded path
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    # ---- try/finally: release pairing (BE-LIFE-403) -----------------
+
+    def visit_Try(self, node: ast.Try) -> None:
+        released: set[str] = set()
+        for stmt in node.finalbody:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    ref = dotted_name(sub.func)
+                    if ref is not None and ref.endswith(".release"):
+                        released.add(ref.rsplit(".", 1)[0])
+        self._finally_release_stack.append(released)
+        for stmt in node.body:
+            self.visit(stmt)
+        for handler in node.handlers:
+            self.visit(handler)
+        for stmt in node.orelse:
+            self.visit(stmt)
+        self._finally_release_stack.pop()
+        self._in_finally += 1
+        for stmt in node.finalbody:
+            self.visit(stmt)
+        self._in_finally -= 1
+
     # ---- attribute writes -------------------------------------------
 
     def _record_write(self, target: ast.AST, node: ast.AST) -> None:
@@ -338,9 +466,76 @@ class _Indexer(ast.NodeVisitor):
                 [target.attr, line, col, self._lock_depth > 0]
             )
 
+    _DICT_CTORS = {"dict", "defaultdict", "OrderedDict", "WeakValueDictionary"}
+    _SPAWN_FUNCS = {"spawn_supervised", "create_task", "ensure_future"}
+
+    def _self_attr(self, node: ast.AST) -> Optional[str]:
+        """``self.X`` -> ``"X"``, else None."""
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node.attr
+        return None
+
+    def _is_dict_value(self, value: ast.AST) -> bool:
+        if isinstance(value, ast.Dict):
+            return True
+        if isinstance(value, ast.Call):
+            ctor = dotted_name(value.func)
+            if ctor is not None and ctor.rsplit(".", 1)[-1] in self._DICT_CTORS:
+                return True
+        return False
+
+    def _record_lifecycle_assign(self, target: ast.AST,
+                                 node: ast.AST) -> None:
+        line, col = self._pos(node)
+        # `self.X[key] = v` with a non-constant key: a keyed-map insert.
+        # `self._m[k] = FAMILY.labels(...)` is the memoized metric-child
+        # idiom — bounded by label cardinality, not lifecycle state
+        if isinstance(target, ast.Subscript):
+            attr = self._self_attr(target.value)
+            is_labels_memo = (
+                isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr == "labels"
+            )
+            if (
+                attr is not None
+                and not isinstance(target.slice, ast.Constant)
+                and not is_labels_memo
+            ):
+                self._fn.map_inserts.append([attr, line, col])
+            return
+        attr = self._self_attr(target)
+        if attr is None:
+            # `task = self._t` local alias (guarded-cancel idiom)
+            if isinstance(target, ast.Name):
+                src = self._self_attr(node.value)
+                if src is not None:
+                    self._alias_stack[-1][target.id] = src
+            return
+        cls = self._class_stack[-1] if self._class_stack else self._fn.cls
+        if self._is_dict_value(node.value):
+            if cls is not None:
+                self.dict_attrs.append([cls, attr, line, col])
+            leaf = self._fn.qualname.rsplit(".", 1)[-1]
+            if leaf not in _CONSTRUCTOR_NAMES:
+                # `self.X = {}` outside __init__ resets the whole map —
+                # that is a sweep of every entry
+                self._fn.map_sweeps.append([attr, line, col])
+        if isinstance(node.value, ast.Call):
+            fn_ref = dotted_name(node.value.func)
+            if fn_ref is not None and (
+                fn_ref.rsplit(".", 1)[-1] in self._SPAWN_FUNCS
+            ):
+                self._fn.task_spawns.append([attr, line, col])
+
     def visit_Assign(self, node: ast.Assign) -> None:
         for target in node.targets:
             self._record_write(target, node)
+            self._record_lifecycle_assign(target, node)
             # PROTO_* string constants are capability definitions
             name = dotted_name(target)
             value = self._const_str(node.value)
@@ -359,9 +554,19 @@ class _Indexer(ast.NodeVisitor):
         self._record_write(node.target, node)
         self.generic_visit(node)
 
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            if isinstance(target, ast.Subscript):
+                attr = self._self_attr(target.value)
+                if attr is not None:
+                    line, col = self._pos(node)
+                    self._fn.map_sweeps.append([attr, line, col])
+        self.generic_visit(node)
+
     def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
         if node.value is not None:
             self._record_write(node.target, node)
+            self._record_lifecycle_assign(node.target, node)
         self.generic_visit(node)
 
     # ---- capability offer / gate sites ------------------------------
@@ -459,6 +664,11 @@ class _Indexer(ast.NodeVisitor):
             base = ref.rsplit(".", 1)[0]
             if base in self.lock_names:
                 self._fn.acquires.append([base, line, col])
+
+        # per-request cost + lifecycle facts (BE-PERF-3xx / BE-LIFE-4xx)
+        if self._fn_stack:
+            self._collect_perf(node, ref, leaf, line, col)
+        self._collect_lifecycle_call(node, ref, leaf, line, col)
 
         # RPC verb calls
         self._collect_verb_call(node, leaf)
@@ -599,6 +809,129 @@ class _Indexer(ast.NodeVisitor):
         if name:
             self.metric_names.append([name, line, col])
 
+    # ---- per-request cost sites (BE-PERF-3xx) -----------------------
+
+    _ENTROPY_CALLS = {"uuid.uuid4", "uuid.uuid1", "os.urandom"}
+    _EAGER_LOG_BASES = {"log", "logger"}
+
+    def _resolve_ref(self, ref: Optional[str]) -> Optional[str]:
+        if ref is None:
+            return None
+        return self.imports.get(ref, ref) if "." not in ref else ref
+
+    @staticmethod
+    def _is_eager_format(arg: ast.AST) -> bool:
+        """f-string / `%`-interpolation / `.format()` — formatting that
+        runs whether or not the level is enabled, unlike the lazy
+        ``log.debug("x %s", v)`` idiom."""
+        if isinstance(arg, ast.JoinedStr):
+            # a constant-only f-string has nothing to format
+            return any(
+                isinstance(v, ast.FormattedValue) for v in arg.values
+            )
+        if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Mod):
+            return True
+        return (
+            isinstance(arg, ast.Call)
+            and isinstance(arg.func, ast.Attribute)
+            and arg.func.attr == "format"
+        )
+
+    def _collect_perf(self, node: ast.Call, ref, leaf, line, col) -> None:
+        # 301 — env read (any key; module-level reads are import-time
+        # and never collected here; memo-guarded reads are cached)
+        if (
+            ref is not None
+            and (ref == "os.getenv" or ref.endswith("environ.get"))
+            and self._memo_depth == 0
+        ):
+            key = self._const_str(node.args[0]) if node.args else None
+            self._fn.perf.append(["env", key or "<dynamic>", line, col])
+
+        # 302 — entropy syscall per call
+        full = self._resolve_ref(ref)
+        if full is not None and (
+            full in self._ENTROPY_CALLS or full.startswith("secrets.")
+        ):
+            self._fn.perf.append(["entropy", full, line, col])
+
+        # 303 — chained `.labels(...).inc()`: a labeled-child lookup per
+        # call.  The cached idioms (`self._m = F.labels(...)` at
+        # construction, `child = self._m[k] = F.labels(...)` on a memo
+        # miss) are assignments, never this chain.
+        if (
+            isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Call)
+            and isinstance(node.func.value.func, ast.Attribute)
+            and node.func.value.func.attr == "labels"
+        ):
+            inner = node.func.value
+            family = dotted_name(inner.func.value) or "<family>"
+            iline, icol = self._pos(inner)
+            self._fn.perf.append(["relabel", family, iline, icol])
+
+        # 304 — regex construction per call
+        if self._resolve_ref(ref) == "re.compile":
+            self._fn.perf.append(["recompile", "", line, col])
+
+        # 305 — eagerly-formatted debug log without a level guard
+        if (
+            leaf == "debug"
+            and isinstance(node.func, ast.Attribute)
+            and self._log_guard_depth == 0
+            and node.args
+            and self._is_eager_format(node.args[0])
+        ):
+            base = dotted_name(node.func.value) or ""
+            tail = base.rsplit(".", 1)[-1].lstrip("_")
+            if tail in self._EAGER_LOG_BASES or "logger" in tail:
+                self._fn.perf.append(["logdebug", base, line, col])
+
+    # ---- lifecycle call sites (BE-LIFE-4xx) -------------------------
+
+    _SWEEP_METHODS = {"pop", "clear", "popitem"}
+
+    def _collect_lifecycle_call(self, node: ast.Call, ref, leaf,
+                                line, col) -> None:
+        if ref is None:
+            return
+        parts = ref.split(".")
+        # `self.X.pop(key)` / `.clear()` sweeps; `.setdefault(k, v)`
+        # inserts — both only on direct self attributes
+        if len(parts) == 3 and parts[0] == "self":
+            attr = parts[1]
+            if leaf in self._SWEEP_METHODS:
+                self._fn.map_sweeps.append([attr, line, col])
+            elif leaf == "setdefault" and node.args and not isinstance(
+                node.args[0], ast.Constant
+            ):
+                self._fn.map_inserts.append([attr, line, col])
+            elif leaf == "cancel":
+                self._fn.task_cancels.append([attr, line, col])
+        elif len(parts) == 2 and leaf == "cancel":
+            # `task.cancel()` through a local alias of a self attr
+            attr = self._alias_stack[-1].get(parts[0])
+            if attr is not None:
+                self._fn.task_cancels.append([attr, line, col])
+
+        # semaphore / lock acquire-release pairing (threading AND
+        # asyncio families — `await sem.acquire()` parses as this Call)
+        if leaf in {"acquire", "release"} and len(parts) >= 2:
+            base = ref.rsplit(".", 1)[0]
+            if base in self.lock_names or base in self.async_lock_names:
+                if leaf == "acquire":
+                    protected = any(
+                        base in s for s in self._finally_release_stack
+                    )
+                    self._fn.sem_acquires.append(
+                        [base, line, col, protected]
+                    )
+                else:
+                    self._fn.sem_releases.append(
+                        [base, line, col, self._in_finally > 0]
+                    )
+
+
 def index_module(path: str, source: str, module_name: str,
                  tree: Optional[ast.Module] = None) -> dict:
     """Build one module's fact index (phase 1).  Pure function of the
@@ -621,11 +954,26 @@ def index_module(path: str, source: str, module_name: str,
 
     lines = source.splitlines()
     per_line, file_wide = _parse_suppressions(lines)
+
+    # `# analyze: hot-path-root` on the def line or the line above it
+    marker_lines = {
+        i for i, raw in enumerate(lines, start=1)
+        if _HOT_PATH_ROOT_RE.search(raw)
+    }
+    hot_path_roots = sorted(
+        f.qualname
+        for f in idx.functions.values()
+        if f.qualname != "<module>"
+        and (f.lineno in marker_lines or f.lineno - 1 in marker_lines)
+    )
+
     return {
         "path": path,
         "module": module_name,
         "sha1": _sha1(source),
         "functions": {q: f.to_dict() for q, f in idx.functions.items()},
+        "hot_path_roots": hot_path_roots,
+        "dict_attrs": idx.dict_attrs,
         "imports": idx.imports,
         "lock_names": sorted(lock_names),
         "async_lock_names": sorted(async_lock_names),
@@ -759,6 +1107,8 @@ class IndexStats:
     files_cached: int = 0       # served from the cache
     jobs: int = 1
     wall_s: float = 0.0
+    # phase-2 wall time per registered project pass (--stats-json)
+    pass_s: dict = field(default_factory=dict)
 
 
 def _index_one(abs_path: str, rel_path: str, module_name: str) -> dict:
@@ -1053,7 +1403,8 @@ def analyze_project(
     rules: Optional[set[str]] = None,
     jobs: Optional[int] = None,
     cache_path: Optional[Path] = DEFAULT_CACHE,
-) -> tuple[list[Finding], IndexStats]:
+    return_context: bool = False,
+):
     """Run both phases: index every module under ``paths`` (phase 1,
     cached + incremental + parallel), then evaluate module findings and
     every registered project pass over the full fact base (phase 2).
@@ -1061,6 +1412,10 @@ def analyze_project(
     ``report_paths`` restricts *module-local* findings to a subset of
     files (the ``--changed`` gate) while cross-module rules still see —
     and report against — the whole project.
+
+    Returns ``(findings, stats)``, or ``(findings, stats, ctx)`` with
+    ``return_context=True`` so callers (``--hot-path-report``) can
+    derive artifacts from the same fact base without re-indexing.
     """
     from bioengine_tpu.analysis.core import project_passes
 
@@ -1091,13 +1446,17 @@ def analyze_project(
 
     docs = parse_docs(root)
     ctx = ProjectContext(records, docs, root)
-    for fn in project_passes().values():
+    for name, fn in project_passes().items():
+        t_pass = time.monotonic()
         for f in fn(ctx):
             if rules is not None and f.rule not in rules:
                 continue
             if ctx.suppressed(f):
                 continue
             out.append(f)
+        stats.pass_s[name] = round(time.monotonic() - t_pass, 4)
 
     out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    if return_context:
+        return out, stats, ctx
     return out, stats

@@ -162,6 +162,9 @@ class Provisioner(abc.ABC):
                 except Exception as e:
                     self.logger.warning(f"cancel {w.worker_id}: {e}")
                 w.state = "gone"
+        # close_all is terminal teardown: drop the records too, or the
+        # registry grows one dead entry per worker ever provisioned
+        self.workers.clear()
 
     def active_workers(self) -> list[WorkerRecord]:
         return [

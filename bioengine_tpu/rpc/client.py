@@ -10,7 +10,6 @@ so worker/app code reads the same against our in-repo control plane.
 from __future__ import annotations
 
 import asyncio
-import uuid
 from typing import Any, Callable, Optional
 
 import aiohttp
@@ -408,7 +407,7 @@ class ServerConnection:
             await self._ws.close()
 
     async def _request(self, msg: dict) -> Any:
-        call_id = uuid.uuid4().hex
+        call_id = tracing.new_id()
         msg["call_id"] = call_id
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[call_id] = fut

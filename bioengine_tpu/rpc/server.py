@@ -265,8 +265,19 @@ class RpcServer:
         token_value: Optional[str] = None,
     ) -> str:
         # token_value lets the worker honor a pre-shared admin token
-        # (env BIOENGINE_ADMIN_TOKEN) instead of a generated one
+        # (env BIOENGINE_ADMIN_TOKEN) instead of a generated one.
+        # Auth tokens MUST be crypto-random (issuance is login-rate,
+        # not request-rate, so the urandom cost is fine here).
+        # bioengine: ignore[BE-PERF-302]
         token = token_value or secrets.token_urlsafe(32)
+        # opportunistic expiry sweep: lazy deletion in validate_token
+        # only reaps tokens that are presented again — without this,
+        # a token minted and never revalidated lives forever
+        now = time.time()
+        for stale in [
+            t for t, info in self._tokens.items() if info.expires_at <= now
+        ]:
+            self._tokens.pop(stale, None)
         self._tokens[token] = TokenInfo(
             user_id=user_id,
             workspace=workspace or self.default_workspace,
@@ -405,7 +416,7 @@ class RpcServer:
         ws = self._clients.get(entry.owner_client)
         if ws is None or ws.closed:
             raise ConnectionError(f"Provider for {full_id} is gone")
-        call_id = uuid.uuid4().hex
+        call_id = tracing.new_id()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[call_id] = fut
         self._pending_owner[call_id] = entry.owner_client
@@ -751,7 +762,7 @@ class RpcServer:
                 try:
                     self._shm_store.delete(probe[0])
                 except Exception as e:  # noqa: BLE001 — client may have deleted it
-                    self.logger.debug(f"probe cleanup raced: {e}")
+                    self.logger.debug("probe cleanup raced: %s", e)
             await self._send(
                 ws,
                 codec,

@@ -346,12 +346,18 @@ class InferenceEngine:
     def params_resident(self) -> bool:
         return self._params_ready.is_set() and self._params_error is None
 
+    _weight_stream_timeout_s: Optional[float] = None
+
     def _wait_params_ready(self) -> None:
         if self._params_ready.is_set() and self._params_error is None:
             return
-        timeout = float(
-            os.environ.get("BIOENGINE_WEIGHT_STREAM_TIMEOUT_S", "600")
-        )
+        # memoized env read: _wait_params_ready sits on the predict hot
+        # path, and the knob only matters before first readiness anyway
+        timeout = InferenceEngine._weight_stream_timeout_s
+        if timeout is None:
+            timeout = InferenceEngine._weight_stream_timeout_s = float(
+                os.environ.get("BIOENGINE_WEIGHT_STREAM_TIMEOUT_S", "600")
+            )
         if not self._params_ready.wait(timeout):
             raise RuntimeError(
                 f"model '{self.model_id}': streamed weights not resident "

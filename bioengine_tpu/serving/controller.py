@@ -367,8 +367,10 @@ class DeploymentHandle:
         # labeled children resolved once — labels() costs a few us of
         # str()/tuple/lock per lookup, paid per request otherwise
         self._m_route_wait = ROUTE_WAIT.labels(app_id, deployment)
+        self._m_failovers = REQUEST_FAILOVERS.labels(app_id, deployment)
         self._m_e2e: dict[str, Any] = {}       # method -> histogram child
         self._m_outcomes: dict[str, Any] = {}  # outcome -> counter child
+        self._m_hedges: dict[str, Any] = {}    # winner -> counter child
 
     def with_options(self, options: RequestOptions) -> "DeploymentHandle":
         """A sibling handle whose calls default to ``options``."""
@@ -650,7 +652,7 @@ class DeploymentHandle:
                         f"after {attempt} attempts: {e}"
                     ) from e
                 if metrics.metrics_enabled():
-                    REQUEST_FAILOVERS.labels(self.app_id, self.deployment).inc()
+                    self._m_failovers.inc()
                 flight.record(
                     "request.failover",
                     severity="warning",
@@ -901,7 +903,12 @@ class DeploymentHandle:
         self, winner: str, delay: float, primary, hedge_replica, method: str
     ) -> None:
         if metrics.metrics_enabled():
-            REQUEST_HEDGES.labels(self.app_id, self.deployment, winner).inc()
+            child = self._m_hedges.get(winner)
+            if child is None:
+                child = self._m_hedges[winner] = REQUEST_HEDGES.labels(
+                    self.app_id, self.deployment, winner
+                )
+            child.inc()
         flight.record(
             "request.hedge",
             app=self.app_id,
@@ -1696,6 +1703,10 @@ class ServeController:
             self._pending_mesh_shards.pop(mesh_rid, None)
             pending.pop(stage, None)
             if pending:
+                # handoff, not a leak: _reconcile_settle stops these
+                # shards host-side and clear()s the whole map when the
+                # recovery grace window closes
+                # bioengine: ignore[BE-LIFE-401]
                 self._surplus_mesh_shards[mesh_rid] = pending
             return False
         shards = [
@@ -2327,6 +2338,10 @@ class ServeController:
             self._queue_depth.pop((app_id, name), None)
             self._rr_counters.pop((app_id, name), None)
             self._outliers.pop((app_id, name), None)
+            # the SLO-page rate limiter seeds per-deployment stamps; a
+            # redeploy under the same name must page immediately, not
+            # inherit the dead app's cooldown (BE-LIFE-401)
+            self._slo_bundle_last.pop((app_id, name), None)
         # observability-state sweep: a dead deployment must not keep
         # alerting or report history as live (get_telemetry races with
         # undeploy by design — see tests/test_slo.py churn test)

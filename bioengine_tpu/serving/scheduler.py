@@ -520,6 +520,7 @@ class DeploymentScheduler:
         self.pressure_fn: Optional[Callable[[], float]] = None
         self._m_admitted: dict[str, Any] = {}
         self._m_wait: dict[str, Any] = {}
+        self._m_rejected: dict[str, Any] = {}  # reason -> counter child
         self._m_batch = SCHED_BATCH_SIZE.labels(app_id, deployment)
         self._m_dispatch = SCHED_DISPATCHES.labels(app_id, deployment)
         _SCHEDULERS.add(self)
@@ -683,7 +684,12 @@ class DeploymentScheduler:
         self, reason: str, priority: str, tenant: Optional[str], method: str
     ) -> None:
         self.stats["rejected"] += 1
-        SCHED_REJECTED.labels(self.app_id, self.deployment, reason).inc()
+        child = self._m_rejected.get(reason)
+        if child is None:
+            child = self._m_rejected[reason] = SCHED_REJECTED.labels(
+                self.app_id, self.deployment, reason
+            )
+        child.inc()
         flight.record(
             "admission.reject",
             severity="warning",

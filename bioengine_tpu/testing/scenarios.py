@@ -532,6 +532,10 @@ class _Plane:
             await self.controller.stop()
         if self.server is not None:
             await self.server.stop()
+        # stopped hosts are useless references — drop them so a plane
+        # held past stop() (scenario asserts) doesn't pin every host
+        self.hosts.clear()
+        self.dead_hosts.clear()
 
 
 async def run_scenario_async(

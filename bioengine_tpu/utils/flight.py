@@ -142,6 +142,9 @@ def dump(reason: str, **attrs: Any) -> Optional[dict]:
     trips a breaker 50 times doesn't produce 50 identical snapshots."""
     if not enabled():
         return None
+    # live env read is deliberate: dumps fire at incident rate (and are
+    # rate-limited right below), and tests retarget the knob at runtime
+    # bioengine: ignore[BE-PERF-301]
     interval = float(os.environ.get("BIOENGINE_FLIGHT_DUMP_INTERVAL_S", "30"))
     now = time.monotonic()
     with _lock:
@@ -169,6 +172,9 @@ def _write_dump(snap: dict) -> None:
     would stall every in-flight request mid-incident, so when a loop is
     running the work is handed to a thread. ``snap`` is a private copy
     (built under the ring lock), safe to serialize concurrently."""
+    # live env read is deliberate: dump-rate, and tests point
+    # BIOENGINE_FLIGHT_DIR at a tmpdir per test without a reload
+    # bioengine: ignore[BE-PERF-301]
     target_dir = os.environ.get("BIOENGINE_FLIGHT_DIR")
     if not target_dir:
         return

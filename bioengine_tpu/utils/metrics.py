@@ -313,6 +313,9 @@ class MetricsRegistry:
                         f"different type or label schema"
                     )
                 return existing
+            # process-lifetime family registry: families are module-
+            # level singletons, never torn down while the process lives
+            # bioengine: ignore[BE-LIFE-401]
             self._metrics[metric.name] = metric
             return metric
 

@@ -62,11 +62,18 @@ _chip: contextvars.ContextVar[Optional["ChipSecondsAccumulator"]] = (
 )
 
 
-def _new_id() -> str:
-    # random.getrandbits, not uuid4: ids need uniqueness, not crypto
-    # randomness, and uuid4's os.urandom syscall costs ~40 us on
-    # sandboxed kernels — minted per request on the serve hot path
+def new_id() -> str:
+    """Mint a 64-bit hex id for call/span correlation.
+
+    random.getrandbits, not uuid4: ids need uniqueness, not crypto
+    randomness, and uuid4's os.urandom syscall costs ~40 us on
+    sandboxed kernels — minted per request on the serve hot path.
+    The rpc layer uses this for call ids too (BE-PERF-302)."""
     return f"{random.getrandbits(64):016x}"
+
+
+# internal callers predate the public name
+_new_id = new_id
 
 
 def _new_trace_id() -> str:

@@ -1,0 +1,209 @@
+"""Seeded resource-lifecycle contract sites for the BE-LIFE-4xx pass.
+
+Per rule: a positive (marked), a suppressed twin, and negative twins
+covering the clean idioms — close-path sweep (direct and delegated
+through a helper), self-bounding cache, guarded alias cancel,
+try/finally release, and the cross-function permit handoff.
+All sync on purpose: BE-ASYNC-008 owns blocking acquires in ``async
+def``, and these classes must not cross-fire it.
+"""
+
+import threading
+
+
+def spawn_supervised(fn):
+    """Stand-in for the supervised-task spawner (leaf-name match)."""
+    return fn
+
+
+# ---- BE-LIFE-401: keyed registry vs the close-path sweep ------------------
+
+
+class LeakyRegistry:
+    """Insert site, close path, no sweep anywhere: fires."""
+
+    def __init__(self):
+        self._items = {}
+
+    def add(self, key, value):
+        self._items[key] = value  # <- BE-LIFE-401
+
+    def close(self):
+        return None
+
+
+class SweptRegistry:
+    """close() clears the map: clean."""
+
+    def __init__(self):
+        self._items = {}
+
+    def add(self, key, value):
+        self._items[key] = value
+
+    def close(self):
+        self._items.clear()
+
+
+class DelegatedSweepRegistry:
+    """The sweep sits behind a helper reachable from close(): clean."""
+
+    def __init__(self):
+        self._items = {}
+
+    def add(self, key, value):
+        self._items[key] = value
+
+    def _evict(self, key):
+        self._items.pop(key, None)
+
+    def close(self):
+        self._evict("all")
+
+
+class SelfBoundedCache:
+    """The inserting function evicts its own entries: clean."""
+
+    def __init__(self):
+        self._cache = {}
+
+    def add(self, key, value):
+        if len(self._cache) > 8:
+            self._cache.pop(next(iter(self._cache)), None)
+        self._cache[key] = value
+
+    def close(self):
+        return None
+
+
+class SuppressedRegistry:
+    """Deliberately unswept (bounded by design): suppressed."""
+
+    def __init__(self):
+        self._seen = {}
+
+    def add(self, key, value):
+        # bounded by construction — keys are a fixed enum
+        # bioengine: ignore[BE-LIFE-401]
+        self._seen[key] = value
+
+    def close(self):
+        return None
+
+
+# ---- BE-LIFE-402: supervised task handle vs the close-path cancel ---------
+
+
+class LeakyWorker:
+    """Spawn stored on self, stop() never cancels: fires."""
+
+    def __init__(self):
+        self._task = None
+
+    def start(self):
+        self._task = spawn_supervised(self._run)  # <- BE-LIFE-402
+
+    def _run(self):
+        return None
+
+    def stop(self):
+        return None
+
+
+class OrphanWorker:
+    """No close-path method at all: fires (different detail)."""
+
+    def start(self):
+        self._task = spawn_supervised(self._run)  # <- BE-LIFE-402
+
+    def _run(self):
+        return None
+
+
+class CancelledWorker:
+    """stop() cancels the handle directly: clean."""
+
+    def start(self):
+        self._task = spawn_supervised(self._run)
+
+    def _run(self):
+        return None
+
+    def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+
+
+class AliasCancelledWorker:
+    """Guarded cancel through a local alias: clean."""
+
+    def start(self):
+        self._task = spawn_supervised(self._run)
+
+    def _run(self):
+        return None
+
+    def stop(self):
+        task = self._task
+        if task is not None:
+            task.cancel()
+
+
+class SuppressedWorker:
+    """Fire-and-forget by design (task exits on its own): suppressed."""
+
+    def start(self):
+        # bioengine: ignore[BE-LIFE-402]
+        self._task = spawn_supervised(self._run)
+
+    def _run(self):
+        return None
+
+    def stop(self):
+        return None
+
+
+# ---- BE-LIFE-403: acquire without an exception-safe release ---------------
+
+
+class PermitLedger:
+    """One semaphore per case so the module-wide handoff check can't
+    mask a genuine leak."""
+
+    def __init__(self):
+        self._leak_sem = threading.Semaphore(4)
+        self._bare_sem = threading.Semaphore(4)
+        self._safe_sem = threading.Semaphore(4)
+        self._handoff_sem = threading.Semaphore(4)
+        self._quiet_sem = threading.Semaphore(4)
+
+    def never_returned(self):
+        self._leak_sem.acquire()  # <- BE-LIFE-403
+        return 1
+
+    def returned_outside_finally(self):
+        self._bare_sem.acquire()  # <- BE-LIFE-403
+        work = 1
+        self._bare_sem.release()
+        return work
+
+    def returned_in_finally(self):
+        """Exception-safe pairing: clean."""
+        self._safe_sem.acquire()
+        try:
+            return 1
+        finally:
+            self._safe_sem.release()
+
+    def take_permit(self):
+        """Cross-function handoff: give_back() returns it — skipped."""
+        self._handoff_sem.acquire()
+
+    def give_back(self):
+        self._handoff_sem.release()
+
+    def deliberate_hold(self):
+        # permit retired on purpose (capacity fencing)
+        # bioengine: ignore[BE-LIFE-403]
+        self._quiet_sem.acquire()
+        return 1

@@ -1,0 +1,110 @@
+"""Seeded hot-path cost sites for the BE-PERF-3xx pass.
+
+``handle_request`` opts in as a request-path root via the
+``# analyze: hot-path-root`` marker (the catalog-free extension
+mechanism); everything it calls is on the hot path.  Each rule has a
+positive (marked), a suppressed twin, and a negative twin — the
+negatives cover the memo-guard, the level-guard, lazy ``%s`` args,
+module-level compilation, and plain unreachability.
+"""
+
+import logging
+import os
+import re
+import uuid
+
+log = logging.getLogger(__name__)
+
+# compiled once at import — the 304-clean idiom
+_WORD_RE = re.compile(r"\w+")
+
+_CACHED_LIMIT = None
+
+
+class _Family:
+    """Stand-in labeled-metric family (labels -> child with .inc())."""
+
+    def labels(self, *values):
+        return self
+
+    def inc(self, amount=1):
+        return amount
+
+
+REQUESTS = _Family()
+
+
+# analyze: hot-path-root
+def handle_request(payload):
+    """Marker-declared request-path root."""
+    rid = mint_request_id()
+    limit = read_limit_per_call()
+    cached = read_limit_cached()
+    count_request()
+    tokens = tokenize(payload)
+    trace(rid, tokens, limit, cached)
+    trace_guarded(rid)
+    suppressed_sites()
+    return rid, tokens
+
+
+def mint_request_id():
+    return uuid.uuid4().hex  # <- BE-PERF-302
+
+
+def read_limit_per_call():
+    return int(os.environ.get("DEMO_REQUEST_LIMIT", "8"))  # <- BE-PERF-301
+
+
+def read_limit_cached():
+    """Memo-guarded read: miss-branch env reads are cached, not
+    per-request — no finding."""
+    global _CACHED_LIMIT
+    if _CACHED_LIMIT is None:
+        _CACHED_LIMIT = int(os.environ.get("DEMO_CACHED_LIMIT", "8"))
+    return _CACHED_LIMIT
+
+
+def count_request():
+    REQUESTS.labels("demo").inc()  # <- BE-PERF-303
+
+
+def tokenize(text):
+    pattern = re.compile(r"[a-z0-9]+")  # <- BE-PERF-304
+    return pattern.findall(text) + _WORD_RE.findall(text)
+
+
+def trace(rid, tokens, limit, cached):
+    log.debug(f"req {rid}: {len(tokens)} tok {limit}/{cached}")  # <- BE-PERF-305
+
+
+def trace_guarded(rid):
+    """Level-guarded + lazy formatting: both clean."""
+    if log.isEnabledFor(logging.DEBUG):
+        log.debug(f"req {rid} (guarded, renders only when DEBUG is on)")
+    log.debug("req %s (lazy args never render eagerly)", rid)
+
+
+def suppressed_sites():
+    """One suppressed twin per BE-PERF-3xx rule."""
+    # bootstrap session id: crypto-random by design, once per session
+    # bioengine: ignore[BE-PERF-302]
+    sid = uuid.uuid4().hex
+    # bioengine: ignore[BE-PERF-301]
+    flag = os.environ.get("DEMO_SUPPRESSED_FLAG")
+    # bioengine: ignore[BE-PERF-303]
+    REQUESTS.labels("suppressed").inc()
+    # bioengine: ignore[BE-PERF-304]
+    pattern = re.compile(r"x+")
+    # bioengine: ignore[BE-PERF-305]
+    log.debug(f"suppressed {sid} {flag} {pattern.pattern}")
+    return sid
+
+
+def cold_path_rebuild():
+    """Same cost classes, but not reachable from any root — the
+    hot-path pass must stay quiet here."""
+    key = os.environ.get("DEMO_COLD_KEY", "cold")
+    pattern = re.compile(key)
+    log.debug(f"cold rebuild {key}")
+    return uuid.uuid4().hex, pattern

@@ -414,3 +414,115 @@ def test_repo_interprocedural_rules_demonstrated_by_baseline():
     rules = {e["rule"] for e in data["findings"].values()}
     assert "BE-ASYNC-006" in rules
     assert "BE-DIST-202" in rules
+
+
+# ---------------------------------------------------------------------------
+# Hot-path cost pass: report artifact, root catalog, stats budget
+# ---------------------------------------------------------------------------
+
+
+def test_hot_path_report_fixture_marker_root(tmp_path):
+    """The ``# analyze: hot-path-root`` marker declares a root without
+    touching the catalog; the report ranks what it reaches and excludes
+    suppressed sites and unreachable functions."""
+    from bioengine_tpu.analysis.hotpath_rules import (
+        REPORT_SCHEMA,
+        build_hot_path_report,
+    )
+
+    _, _, ctx = analyze_project(
+        [PROJ], root=PROJ, cache_path=None, return_context=True
+    )
+    report = build_hot_path_report(ctx)
+    assert report["schema"] == REPORT_SCHEMA
+
+    marker_roots = [
+        r for r in report["roots"] if r["origin"] == "marker"
+    ]
+    assert any(
+        r["qualname"] == "handle_request" and r["path"] == "perf_mod.py"
+        for r in marker_roots
+    )
+
+    by_qual = {
+        f["qualname"]: f
+        for f in report["functions"]
+        if f["path"] == "perf_mod.py"
+    }
+    # the root itself and its callees are all in the reachable set
+    assert {"handle_request", "mint_request_id", "tokenize"} <= set(by_qual)
+    # unreachable functions never make the overhead map
+    assert "cold_path_rebuild" not in by_qual
+    # suppressed twins don't count toward the ranking
+    assert by_qual["suppressed_sites"]["findings"] == 0
+    # score is findings x call-graph depth, one rule bucket per hit
+    mint = by_qual["mint_request_id"]
+    assert mint["rules"] == {"BE-PERF-302": 1}
+    assert mint["score"] == mint["findings"] * mint["depth"]
+    assert report["totals"]["reachable_functions"] == len(
+        report["functions"]
+    )
+
+
+def test_hot_path_report_covers_all_catalog_roots(tmp_path):
+    """Every checked-in request-path root resolves to a real function —
+    a rename that orphans a catalog entry fails here, not silently."""
+    from bioengine_tpu.analysis.hotpath_rules import (
+        HOT_PATH_ROOT_CATALOG,
+        build_hot_path_report,
+    )
+
+    repo = Path(__file__).parent.parent
+    _, _, ctx = analyze_project(
+        [repo / "bioengine_tpu"],
+        root=repo,
+        cache_path=tmp_path / "cache.json",
+        return_context=True,
+    )
+    report = build_hot_path_report(ctx)
+    resolved = {
+        (r["path"], r["qualname"])
+        for r in report["roots"]
+        if r["origin"] == "catalog"
+    }
+    for module, qual in HOT_PATH_ROOT_CATALOG:
+        path = module.replace(".", "/") + ".py"
+        assert (path, qual) in resolved, f"catalog root {module}:{qual}"
+    assert report["totals"]["roots"] >= len(HOT_PATH_ROOT_CATALOG)
+    assert report["totals"]["reachable_functions"] > len(
+        HOT_PATH_ROOT_CATALOG
+    )
+
+
+def test_stats_json_schema_and_cold_wall_budget(tmp_path, monkeypatch):
+    """A cold full-repo gate run (fresh cache) stays inside the 10s CI
+    budget, exits clean against the checked-in baseline, and emits the
+    machine-readable stats the perf probe consumes."""
+    from bioengine_tpu.analysis.__main__ import main
+
+    repo = Path(__file__).parent.parent
+    monkeypatch.chdir(repo)
+    stats_path = tmp_path / "stats.json"
+    rc = main(
+        [
+            "bioengine_tpu",
+            "apps",
+            "--cache",
+            str(tmp_path / "cache.json"),
+            "--stats-json",
+            str(stats_path),
+        ]
+    )
+    assert rc == 0  # zero unbaselined findings on the repo itself
+    stats = json.loads(stats_path.read_text())
+    assert stats["schema"] == "bioengine.analyze-stats/v1"
+    assert stats["files_indexed"] == stats["files_total"] > 0
+    assert stats["files_cached"] == 0  # cold: nothing from cache
+    assert stats["wall_s"] < 10.0
+    # every registered project pass reports its own timing
+    assert {"interproc", "dist", "hotpath", "lifecycle"} <= set(
+        stats["passes"]
+    )
+    assert all(
+        isinstance(v, float) and v >= 0 for v in stats["passes"].values()
+    )
