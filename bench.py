@@ -63,7 +63,8 @@ Env overrides:
   BENCH_CONFIGS=a,b,c   subset of vit,unet,sharded_serving,
                         multihost_mesh,cold_start,cellpose,search,
                         observability_overhead,scheduler_goodput,flash,
-                        unet3d,ivfpq,pqflat,rpc_transport
+                        unet3d,ivfpq,pqflat,rpc_transport,
+                        request_overhead
   BENCH_PROBE_CADENCE=N seconds between tunnel probes while wedged
                         (default 60)
   BENCH_REPS=N          timed reps per stage (default 2, best-of)
@@ -102,6 +103,7 @@ STAGE_COSTS = {
     "ivfpq": 70,   # measured 46 s standalone (train 20 + encode 22)
     "pqflat": 80,
     "rpc_transport": 60,
+    "request_overhead": 30,
 }
 DEFAULT_CONFIGS = tuple(STAGE_COSTS)
 
@@ -1601,6 +1603,276 @@ def _bench_rpc_transport(cpu: bool) -> dict:
     return asyncio.run(run())
 
 
+def _bench_request_overhead(cpu: bool) -> dict:  # noqa: ARG001 — pure host path
+    """Per-request microsecond budget on the SMALL-request hot path.
+
+    Three legs in one interpreter against a trivial echo/add service
+    over the real websocket stack: ``baseline`` is yesterday's stack
+    end to end (oob1+trace1 wire, no fast frames, per-call supervised
+    task dispatch, pre-fast1 request bookkeeping via compat_pre_fast1,
+    TCP); ``fast_tcp`` isolates the codec + inline-
+    dispatch de-tax on the identical wire; ``fast`` adds the same-host
+    unix-socket listener — the full optimized path a co-located worker
+    gets. Legs run INTERLEAVED in rounds and each reports its best
+    round, so whole-machine drift (noisy CI neighbors) cancels out of
+    the ratios. Each leg reports the uncontended path (one request in
+    flight at a time — the acceptance gate: fast must be >=2x baseline
+    req/s) and a pipelined-concurrency path (C callers multiplexed on
+    one connection).
+
+    The decomposition buckets attribute the baseline per-request budget:
+    ``codec`` is measured on the live traffic via RpcStats (client +
+    server encode+decode); ``tracing_ctx`` / ``scoring`` / ``scheduler``
+    / ``asyncio_hop`` are targeted perf_counter_ns micro-probes of the
+    exact operations the request path runs per call; ``wire_residual``
+    is what remains of the uncontended p50 — the aiohttp frame machinery
+    and event-loop wakeups that every codec pays.
+
+    Env: BENCH_REQ_ROUNDS / BENCH_REQ_N / BENCH_REQ_CALLERS /
+    BENCH_REQ_PER_CALLER."""
+    import asyncio
+
+    from bioengine_tpu.rpc import protocol
+    from bioengine_tpu.rpc.client import connect_to_server
+    from bioengine_tpu.rpc.server import RpcServer
+    from bioengine_tpu.serving.scheduler import HeuristicCostModel, batch_signature
+    from bioengine_tpu.utils import tracing
+
+    rounds = int(os.environ.get("BENCH_REQ_ROUNDS", "9"))
+    n_serial = int(os.environ.get("BENCH_REQ_N", "400"))
+    callers = int(os.environ.get("BENCH_REQ_CALLERS", "32"))
+    per_caller = int(os.environ.get("BENCH_REQ_PER_CALLER", "40"))
+
+    async def setup_leg(fast: bool, uds: bool = False) -> dict:
+        server = RpcServer(
+            shm_store=None,
+            inline_dispatch=fast,
+            uds_path="/tmp/bioengine-bench-req.sock" if uds else None,
+        )
+        await server.start()
+        server.register_local_service(
+            {"id": "echo", "echo": lambda x: x, "add": lambda a, b: a + b}
+        )
+        conn = await connect_to_server(
+            {
+                "server_url": (
+                    f"unix://{server.uds_path}"
+                    if uds
+                    else f"http://127.0.0.1:{server.port}"
+                ),
+                # baseline = the pre-fast1 stack end to end: oob1+trace1
+                # declared (yesterday's wire bytes) AND the pre-fast1
+                # per-request bookkeeping (uuid call ids, wait_for
+                # timeout chain) via compat_pre_fast1 — this PR also
+                # de-taxed the shared request path, so without the
+                # compat flag the baseline leg would silently inherit
+                # those wins and under-state the pre-PR cost
+                "protocols": (
+                    None
+                    if fast
+                    else [protocol.PROTO_OOB1, protocol.PROTO_TRACE1]
+                ),
+                "compat_pre_fast1": not fast,
+            }
+        )
+        return {
+            "server": server,
+            "conn": conn,
+            "transport": "uds" if uds else "tcp",
+        }
+
+    def codec_seconds(leg: dict) -> float:
+        return (
+            leg["conn"].codec.stats.encode_seconds
+            + leg["conn"].codec.stats.decode_seconds
+            + leg["server"].stats.encode_seconds
+            + leg["server"].stats.decode_seconds
+        )
+
+    async def serial_round(conn) -> dict:
+        lat_us: list = []
+        t_start = time.perf_counter()
+        for _ in range(n_serial):
+            t0 = time.perf_counter_ns()
+            await conn.call("bioengine/echo", "echo", "ping")
+            lat_us.append((time.perf_counter_ns() - t0) / 1000.0)
+        wall = time.perf_counter() - t_start
+        lat_us.sort()
+        return {
+            "req_per_sec": n_serial / wall,
+            "p50_us": lat_us[len(lat_us) // 2],
+            "p95_us": lat_us[min(int(len(lat_us) * 0.95), len(lat_us) - 1)],
+        }
+
+    async def concurrent_round(conn) -> float:
+        async def caller() -> None:
+            for _ in range(per_caller):
+                await conn.call("bioengine/echo", "add", 1, 2)
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*[caller() for _ in range(callers)])
+        return callers * per_caller / (time.perf_counter() - t0)
+
+    def probe_us(fn, n: int = 20000) -> float:
+        fn()  # warm
+        t0 = time.perf_counter_ns()
+        for _ in range(n):
+            fn()
+        return (time.perf_counter_ns() - t0) / n / 1000.0
+
+    async def probe_hop_us(n: int = 5000) -> float:
+        # the per-call supervised-task tax inline dispatch removes:
+        # create_task + loop schedule + run + completion wakeup
+        async def nop() -> None:
+            pass
+
+        loop = asyncio.get_running_loop()
+        await loop.create_task(nop())  # warm
+        t0 = time.perf_counter_ns()
+        for _ in range(n):
+            await loop.create_task(nop())
+        return (time.perf_counter_ns() - t0) / n / 1000.0
+
+    scorer = HeuristicCostModel()
+    features = {
+        "load": 0.4,
+        "queued": 1,
+        "max_ongoing": 8,
+        "breaker_failures": 0,
+        "signature_affinity": 1.0,
+        "avoided": False,
+        "probation": False,
+        "group_size": 1,
+    }
+
+    async def run() -> dict:
+        legs = {
+            "baseline": await setup_leg(fast=False),
+            "fast_tcp": await setup_leg(fast=True),
+            "fast": await setup_leg(fast=True, uds=True),
+        }
+        try:
+            for leg in legs.values():  # warm paths (caches, ws buffers)
+                for _ in range(100):
+                    await leg["conn"].call("bioengine/echo", "add", 1, 2)
+            serial: dict = {k: [] for k in legs}
+            conc: dict = {k: [] for k in legs}
+            codec0 = {k: codec_seconds(leg) for k, leg in legs.items()}
+            order = list(legs.items())
+            for i in range(rounds):
+                # interleave legs within each round so machine-wide
+                # noise hits every leg of a round equally, and flip the
+                # order on alternate rounds so weather that shifts
+                # MID-round doesn't systematically favor one position
+                seq = order if i % 2 == 0 else order[::-1]
+                for k, leg in seq:
+                    serial[k].append(await serial_round(leg["conn"]))
+                for k, leg in seq:
+                    conc[k].append(await concurrent_round(leg["conn"]))
+            out_legs: dict = {}
+            for k, leg in legs.items():
+                total_reqs = rounds * (n_serial + callers * per_caller)
+                codec_us = (
+                    (codec_seconds(leg) - codec0[k]) / total_reqs * 1e6
+                )
+                best = max(serial[k], key=lambda r: r["req_per_sec"])
+                med = sorted(
+                    serial[k], key=lambda r: r["req_per_sec"]
+                )[len(serial[k]) // 2]
+                st = leg["conn"].codec.stats.as_dict()
+                out_legs[k] = {
+                    "transport": leg["transport"],
+                    "uncontended": {
+                        "req_per_sec": round(best["req_per_sec"], 1),
+                        "p50_us": round(best["p50_us"], 1),
+                        "p95_us": round(best["p95_us"], 1),
+                        "median_req_per_sec": round(med["req_per_sec"], 1),
+                        "n": n_serial,
+                        "rounds": rounds,
+                    },
+                    "concurrent": {
+                        "req_per_sec": round(max(conc[k]), 1),
+                        "median_req_per_sec": round(
+                            sorted(conc[k])[len(conc[k]) // 2], 1
+                        ),
+                        "callers": callers,
+                        "n": callers * per_caller,
+                    },
+                    "codec_us_per_req": round(codec_us, 2),
+                    "fast_frames": bool(leg["conn"].codec.fast),
+                    "small_frames_out": st["small_frames_out"],
+                    "fast_frame_hit_rate": st["fast_frame_hit_rate"],
+                }
+        finally:
+            for leg in legs.values():
+                await leg["conn"].disconnect()
+                await leg["server"].stop()
+
+        baseline = out_legs["baseline"]
+        decomposition = {
+            "codec_us": baseline["codec_us_per_req"],
+            "tracing_ctx_us": round(
+                probe_us(
+                    lambda: (tracing.current_trace_and_span(), tracing.sampled())
+                ),
+                3,
+            ),
+            "scheduler_us": round(
+                probe_us(
+                    lambda: batch_signature("echo", (1, 2.0), {"scale": 2.0})
+                ),
+                3,
+            ),
+            "scoring_us": round(probe_us(lambda: scorer.score(features)), 3),
+            "asyncio_hop_us": round(await probe_hop_us(), 3),
+        }
+        accounted = sum(decomposition.values())
+        decomposition["wire_residual_us"] = round(
+            max(baseline["uncontended"]["p50_us"] - accounted, 0.0), 1
+        )
+        # PAIRED ratio estimator: the legs interleave inside each
+        # round, so the ratio computed within one round sees the same
+        # machine weather on both sides; the median over rounds then
+        # rejects the outlier rounds entirely. A best-of-rounds or
+        # grand-mean ratio is badly biased by one lucky/unlucky window
+        # landing on a single leg.
+        def paired_speedup(series: dict) -> float:
+            ratios = sorted(
+                f / max(b, 1e-9)
+                for f, b in zip(series["fast"], series["baseline"])
+            )
+            return round(ratios[len(ratios) // 2], 2)
+
+        serial_rps = {
+            k: [r["req_per_sec"] for r in v] for k, v in serial.items()
+        }
+        return {
+            "legs": out_legs,
+            "decomposition_us": decomposition,
+            "uncontended_speedup": paired_speedup(serial_rps),
+            "concurrent_speedup": paired_speedup(conc),
+            "threshold_bytes": protocol.FAST_THRESHOLD_DEFAULT,
+            "note": (
+                "baseline leg reproduces the pre-PR stack end to end "
+                "(legacy wire config + compat_pre_fast1 request "
+                "bookkeeping + task-per-call dispatch) in the same "
+                "interpreter as the fast legs. "
+                "legs interleave per round; speedups are the MEDIAN of "
+                "per-round paired fast/baseline ratios (same-round "
+                "pairing cancels machine drift); each leg also reports "
+                "its best and median round. "
+                "decomposition buckets attribute the BASELINE budget: "
+                "codec from live RpcStats on the measured traffic; "
+                "tracing/scheduler/scoring/asyncio-hop from targeted "
+                "perf_counter_ns probes of the per-request operations; "
+                "wire_residual = uncontended p50 minus accounted buckets "
+                "(aiohttp frame machinery + loop wakeups)"
+            ),
+        }
+
+    return asyncio.run(run())
+
+
 def _bench_observability(cpu: bool) -> dict:  # noqa: ARG001 — pure host path
     """Per-request cost of the observability substrate on the serve
     hot path. Four legs over the same live controller + replica
@@ -2201,6 +2473,7 @@ def worker_main() -> int:
         "ivfpq": _bench_ivfpq,
         "pqflat": _bench_pqflat,
         "rpc_transport": _bench_rpc_transport,
+        "request_overhead": _bench_request_overhead,
     }
     if os.environ.get("BENCH_SLEEP_S"):
         # test-only stage (tests/test_bench.py): a deterministic
@@ -2515,6 +2788,7 @@ def _final_json(shared: _Shared, deadline_hit: bool) -> str:
             "pqflat_tpu_1m": shared.stages.get("pqflat"),
             "flash_attention": shared.stages.get("flash"),
             "rpc_transport": shared.stages.get("rpc_transport"),
+            "request_overhead": shared.stages.get("request_overhead"),
             "observability_overhead": shared.stages.get(
                 "observability_overhead"
             ),

@@ -34,6 +34,12 @@ class ConnectionLost(ConnectionError):
     calls."""
 
 
+def _expire_request(fut: asyncio.Future) -> None:
+    # timer callback for _request: fires only if the RESULT never came
+    if not fut.done():
+        fut.set_exception(asyncio.TimeoutError())
+
+
 class ServiceProxy:
     """Callable facade over a remote service: ``await svc.method(...)``."""
 
@@ -69,8 +75,16 @@ class ServerConnection:
         protocols: Optional[list[str]] = None,
         auto_reconnect: bool = False,
         reconnect_max_backoff_s: float = 5.0,
+        compat_pre_fast1: bool = False,
     ):
         self.url = url
+        # same-host deployments skip the TCP stack entirely:
+        # ``unix:///path/to.sock`` dials the server's unix-domain
+        # listener — ~40% lower per-message syscall cost on the
+        # small-request hot path (docs/performance.md)
+        self._uds_path: Optional[str] = (
+            url[len("unix://"):] if url.startswith("unix://") else None
+        )
         self.token = token
         self.timeout = timeout
         # capabilities declared at handshake; [] forces pure-legacy
@@ -82,6 +96,7 @@ class ServerConnection:
                 protocol.PROTO_TELEM1,
                 protocol.PROTO_MESH1,
                 protocol.PROTO_EPOCH1,
+                protocol.PROTO_FAST1,
             ]
             if protocols is None
             else list(protocols)
@@ -110,6 +125,17 @@ class ServerConnection:
         self._session: Optional[aiohttp.ClientSession] = None
         self._ws: Optional[aiohttp.ClientWebSocketResponse] = None
         self._pending: dict[str, asyncio.Future] = {}
+        # call ids need per-connection uniqueness, not global entropy:
+        # one random prefix at construction, then a counter — minting
+        # 64 random bits per request shows up on the microsecond path
+        self._call_prefix = f"{tracing.new_id()[:8]}-"
+        self._call_seq = 0
+        # measurement compat: reproduce the pre-fast1 per-request
+        # bookkeeping (a fresh uuid call id + an asyncio.wait_for
+        # timeout chain per call) so the request_overhead bench's
+        # baseline leg measures the pre-optimization stack in the SAME
+        # interpreter as the fast leg. Never set on production paths.
+        self._compat_request = compat_pre_fast1
         self._local_services: dict[str, dict[str, Callable]] = {}
         self._service_definitions: dict[str, dict[str, Any]] = {}
         self._reader_task: Optional[asyncio.Task] = None
@@ -127,8 +153,15 @@ class ServerConnection:
         """One transport bring-up: websocket + welcome + reader + shm
         negotiation. Shared by ``connect`` and the reconnect loop."""
         await self._teardown_transport()
-        self._session = aiohttp.ClientSession()
-        url = self.url
+        if self._uds_path is not None:
+            self._session = aiohttp.ClientSession(
+                connector=aiohttp.UnixConnector(path=self._uds_path)
+            )
+            # the connector owns routing; the authority is synthetic
+            url = "ws://localhost/ws"
+        else:
+            self._session = aiohttp.ClientSession()
+            url = self.url
         # declare codec support at handshake; a pre-oob server ignores
         # unknown query params and its welcome carries no "protocols",
         # so both sides settle on legacy frames automatically
@@ -154,6 +187,11 @@ class ServerConnection:
         # advertise trace1 — a legacy peer never sees them on the wire
         self.codec.trace = protocol.PROTO_TRACE1 in self.protocols and (
             protocol.PROTO_TRACE1 in welcome.get("protocols", [])
+        )
+        # BEFS small-request frames, same both-sides rule as oob1 —
+        # a legacy peer keeps seeing byte-identical legacy frames
+        self.codec.fast = protocol.PROTO_FAST1 in self.protocols and (
+            protocol.PROTO_FAST1 in welcome.get("protocols", [])
         )
         self._reader_task = asyncio.create_task(self._read_loop())
         if self.codec.oob and isinstance(welcome.get("shm"), dict):
@@ -235,6 +273,7 @@ class ServerConnection:
             "url": self.url,
             "connected": self.connected,
             "oob": self.codec.oob,
+            "fast": self.codec.fast,
             "shm": self.codec.shm_store.name
             if self.codec.shm_store is not None
             else None,
@@ -253,8 +292,31 @@ class ServerConnection:
             async for msg in self._ws:
                 if msg.type != aiohttp.WSMsgType.BINARY:
                     continue
+                raw = msg.data
                 try:
-                    data = await self.codec.decode_async(msg.data)
+                    if protocol.is_fast_frame(raw):
+                        # BEFS: sync decode, no pins to drain. A
+                        # RESULT resolves its future straight from the
+                        # (call_id, value) parse — fast frames can
+                        # never carry spans or errors, so the generic
+                        # handling below has nothing to add
+                        parsed = self.codec.decode_fast_result_frame(raw)
+                        if parsed is not None:
+                            fut = self._pending.pop(parsed[0], None)
+                            if fut is not None and not fut.done():
+                                fut.set_result(parsed[1])
+                            continue
+                        data = self.codec.decode_fast_frame(raw)
+                    else:
+                        try:
+                            data = await self.codec.decode_async(raw)
+                        finally:
+                            # retry releasing pins of earlier shm
+                            # payloads whose consumers have since
+                            # dropped their views (results are handed
+                            # to caller futures, so the release point
+                            # is only observable opportunistically)
+                            self.codec.drain_pins()
                 except Exception as e:  # noqa: BLE001
                     # a poisoned message (e.g. its shm object was
                     # evicted before we consumed it) must cost only
@@ -262,12 +324,6 @@ class ServerConnection:
                     # connection and every other in-flight call live
                     self.logger.error(f"dropping undecodable message: {e}")
                     continue
-                finally:
-                    # retry releasing pins of earlier shm payloads
-                    # whose consumers have since dropped their views
-                    # (results are handed to caller futures, so the
-                    # release point is only observable opportunistically)
-                    self.codec.drain_pins()
                 if data is None:
                     continue  # mid-reassembly chunk
                 t = data.get("t")
@@ -395,7 +451,16 @@ class ServerConnection:
         ws = self._ws
         if ws is None or ws.closed:
             raise ConnectionLost("rpc connection is down")
-        for frame in await self.codec.encode_frames_async(msg):
+        codec = self.codec
+        if codec.fast:
+            # small-request hot path: one sync encode attempt, one
+            # send — skips the encode_frames_async coroutine and the
+            # payload-size walk entirely when it hits
+            frame = codec.encode_fast_frame(msg)
+            if frame is not None:
+                await ws.send_bytes(frame)
+                return
+        for frame in await codec.encode_frames_async(msg):
             await ws.send_bytes(frame)
 
     async def _abort_connection(self) -> None:
@@ -407,13 +472,33 @@ class ServerConnection:
             await self._ws.close()
 
     async def _request(self, msg: dict) -> Any:
-        call_id = tracing.new_id()
-        msg["call_id"] = call_id
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        if self._compat_request:
+            # pre-fast1 request path, kept verbatim for the bench's
+            # baseline leg (see compat_pre_fast1 in __init__)
+            msg["call_id"] = call_id = tracing.new_id()
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._pending[call_id] = fut
+            try:
+                await self._send_msg(msg)
+                return await asyncio.wait_for(fut, self.timeout)
+            finally:
+                self._pending.pop(call_id, None)
+        self._call_seq = seq = self._call_seq + 1
+        msg["call_id"] = call_id = f"{self._call_prefix}{seq:x}"
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
         self._pending[call_id] = fut
         try:
             await self._send_msg(msg)
-            return await asyncio.wait_for(fut, self.timeout)
+            # a bare timer handle, not asyncio.wait_for: wait_for
+            # allocates an extra future + callback chain per call —
+            # measurable on the small-request path. Semantics match:
+            # TimeoutError after self.timeout, cancelled on exit.
+            timer = loop.call_later(self.timeout, _expire_request, fut)
+            try:
+                return await fut
+            finally:
+                timer.cancel()
         finally:
             # RESULT/ERROR pop on arrival; this covers timeout/cancel so
             # abandoned futures don't accumulate across reconnects
@@ -439,10 +524,14 @@ class ServerConnection:
         try:
             service = self._local_services[msg["service_id"]]
             fn = service[msg["method"]]
-            with tracing.trace_span(
-                "rpc.handle",
-                service=msg["service_id"],
-                method=msg["method"],
+            with (
+                tracing.span(
+                    "rpc.handle",
+                    service=msg["service_id"],
+                    method=msg["method"],
+                )
+                if tracing.sampled()
+                else tracing.NOOP_SPAN
             ):
                 result = fn(*msg.get("args", []), **msg.get("kwargs", {}))
                 if asyncio.iscoroutine(result):
@@ -511,6 +600,14 @@ class ServerConnection:
         raise KeyError(f"Service '{service_id}' not found")
 
     async def call(self, service_id: str, method: str, *args, **kwargs) -> Any:
+        codec = self.codec
+        ctx = tracing.current_trace()
+        traced = codec.trace and ctx is not None and ctx.sampled
+        if codec.fast and not traced and not self._compat_request:
+            # small-request hot path: encode straight from the call
+            # site — the envelope dict is only built if the fast
+            # encode bails (oversize / non-scalar payload)
+            return await self._request_fast(service_id, method, args, kwargs)
         msg = {
             "t": protocol.CALL,
             "service_id": service_id,
@@ -518,10 +615,47 @@ class ServerConnection:
             "args": list(args),
             "kwargs": kwargs,
         }
-        ctx = tracing.current_trace()
-        if self.codec.trace and ctx is not None and ctx.sampled:
+        if traced:
             msg["trace"] = ctx.to_wire()
         return await self._request(msg)
+
+    async def _request_fast(
+        self, service_id: str, method: str, args: tuple, kwargs: dict
+    ) -> Any:
+        self._call_seq = seq = self._call_seq + 1
+        call_id = f"{self._call_prefix}{seq:x}"
+        frame = self.codec.encode_fast_call_frame(
+            call_id, service_id, method, args, kwargs
+        )
+        if frame is None:
+            return await self._request(
+                {
+                    "t": protocol.CALL,
+                    "service_id": service_id,
+                    "method": method,
+                    "args": list(args),
+                    "kwargs": kwargs,
+                }
+            )
+        # inlined _send_msg minus the encode (already done): one fault
+        # gate, one liveness check, one send
+        if faults.ACTIVE:
+            await faults.hit("rpc.client.send", drop=self._abort_connection)
+        ws = self._ws
+        if ws is None or ws.closed:
+            raise ConnectionLost("rpc connection is down")
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._pending[call_id] = fut
+        try:
+            await ws.send_bytes(frame)
+            timer = loop.call_later(self.timeout, _expire_request, fut)
+            try:
+                return await fut
+            finally:
+                timer.cancel()
+        finally:
+            self._pending.pop(call_id, None)
 
     async def generate_token(self, config: Optional[dict] = None) -> str:
         config = config or {}
@@ -587,10 +721,13 @@ async def connect_to_server(config: dict[str, Any]) -> ServerConnection:
     (auto-reconnect with backoff on an unexpected drop; registered
     services are re-registered transparently)."""
     url = config["server_url"]
-    if url.startswith("http"):
-        url = "ws" + url[4:]
-    if not url.endswith("/ws"):
-        url = url.rstrip("/") + "/ws"
+    if url.startswith("unix://"):
+        pass  # a socket path, not an authority — used verbatim
+    else:
+        if url.startswith("http"):
+            url = "ws" + url[4:]
+        if not url.endswith("/ws"):
+            url = url.rstrip("/") + "/ws"
     conn = ServerConnection(
         url,
         token=config.get("token"),
@@ -599,5 +736,6 @@ async def connect_to_server(config: dict[str, Any]) -> ServerConnection:
         transport_config=config.get("transport_config"),
         protocols=config.get("protocols"),
         auto_reconnect=bool(config.get("reconnect", False)),
+        compat_pre_fast1=bool(config.get("compat_pre_fast1", False)),
     )
     return await conn.connect()

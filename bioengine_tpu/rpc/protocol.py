@@ -45,6 +45,7 @@ dispatch is unambiguous.
 
 from __future__ import annotations
 
+import struct
 import traceback
 from typing import Any, Callable, Optional
 
@@ -66,7 +67,9 @@ SHM_ACK = "shm_ack"            # client proves it mapped the shared store
 # wire identifiers
 OOB_MAGIC = b"BEF1"            # out-of-band scatter-gather frame
 CHUNK_MAGIC = b"BEC1"          # one chunk of an oversized frame
+FAST_MAGIC = b"BEFS"           # fixed-layout small-request fast frame
 PROTO_OOB1 = "oob1"            # negotiated capability name
+PROTO_FAST1 = "fast1"          # small-request fast frames (BEFS)
 PROTO_TRACE1 = "trace1"        # request-trace fields on CALL/RESULT
 PROTO_TELEM1 = "telem1"        # push-telemetry verbs on the serve-router
 PROTO_MESH1 = "mesh1"          # cross-host mesh shards (mesh_shard on
@@ -317,3 +320,353 @@ def decode_oob(data, shm_get: Optional[Callable] = None) -> dict:
         return _ext_hook(code, ext_data)
 
     return msgpack.unpackb(meta["h"], ext_hook=hook, raw=False)
+
+
+# ---------------------------------------------------------------------------
+# Small-request fast frames (BEFS)
+# ---------------------------------------------------------------------------
+#
+# The microsecond budget of a 1 KB call is dominated by envelope work:
+# the oob pre-walk, a double msgpack pack, and ExtType dispatch. A fast
+# frame is a fixed-layout struct-packed encoding for the two hot
+# envelopes only — an untraced CALL and a span-free RESULT — whose
+# values are scalars/strings/small bytes (shallow lists/dicts of the
+# same allowed, so batched ``replica_call`` envelopes qualify). One
+# single-pass pack into a caller-supplied scratch buffer, no msgpack,
+# no pre-walk. Anything else — traces, spans, ndarrays, exceptions,
+# oversize values — makes ``encode_fast`` return None and the caller
+# falls back to the full codec, so the fast path can never change what
+# a message can carry. Negotiated as ``fast1``; like the oob magic,
+# 0x42 cannot open a legacy msgpack map, so dispatch stays unambiguous.
+#
+# Frame layout (little-endian)::
+#
+#     b"BEFS" | u8 kind | body
+#     kind 1 (CALL):   str16 call_id | str16 service_id | str16 method
+#                      | u8 n_args | value*  | u8 n_kwargs
+#                      | (str16 key, value)*
+#     kind 2 (RESULT): str16 call_id | value
+#     str16 = u16 len | utf-8 bytes
+#     value = u8 tag | payload    (tags below)
+
+FAST_KIND_CALL = 1
+FAST_KIND_RESULT = 2
+
+_FT_NONE = 0
+_FT_TRUE = 1
+_FT_FALSE = 2
+_FT_INT = 3       # s64
+_FT_FLOAT = 4     # f64
+_FT_STR = 5       # u32 len | utf-8
+_FT_BYTES = 6     # u32 len | raw
+_FT_LIST = 7      # u8 count | value*
+_FT_DICT = 8      # u8 count | (str16 key, value)*
+
+# Per-value size guard: a single str/bytes longer than this can never
+# fit a fast frame regardless of the negotiated limit, so bail before
+# copying it into the scratch buffer.
+_FAST_VALUE_LIMIT = 65536
+# Default whole-frame threshold; transport exposes it as a config knob
+# (BIOENGINE_RPC_FAST_THRESHOLD).
+FAST_THRESHOLD_DEFAULT = 4096
+
+_PACK_Q = struct.Struct("<q").pack
+_PACK_D = struct.Struct("<d").pack
+_UNPACK_Q = struct.Struct("<q").unpack_from
+_UNPACK_D = struct.Struct("<d").unpack_from
+_UNPACK_H = struct.Struct("<H").unpack_from
+_UNPACK_I = struct.Struct("<I").unpack_from
+
+_FAST_CALL_PREFIX = FAST_MAGIC + bytes([FAST_KIND_CALL])
+_FAST_RESULT_PREFIX = FAST_MAGIC + bytes([FAST_KIND_RESULT])
+
+
+class _FastUnsupported(Exception):
+    """Internal: value not expressible in a fast frame (fall back)."""
+
+
+def is_fast_frame(data) -> bool:
+    return bytes(data[:4]) == FAST_MAGIC
+
+
+def _fast_str16(out: bytearray, s: str) -> None:
+    b = s.encode("utf-8")
+    if len(b) > 65535:
+        raise _FastUnsupported
+    out += len(b).to_bytes(2, "little")
+    out += b
+
+
+def _fast_pack_value(out: bytearray, v: Any, depth: int) -> None:
+    t = type(v)
+    if v is None:
+        out.append(_FT_NONE)
+    elif t is bool:
+        out.append(_FT_TRUE if v else _FT_FALSE)
+    elif t is int:
+        out.append(_FT_INT)
+        out += _PACK_Q(v)  # struct.error on >64-bit -> fallback
+    elif t is float:
+        out.append(_FT_FLOAT)
+        out += _PACK_D(v)
+    elif t is str:
+        b = v.encode("utf-8")
+        if len(b) > _FAST_VALUE_LIMIT:
+            raise _FastUnsupported
+        out.append(_FT_STR)
+        out += len(b).to_bytes(4, "little")
+        out += b
+    elif t is bytes:
+        if len(v) > _FAST_VALUE_LIMIT:
+            raise _FastUnsupported
+        out.append(_FT_BYTES)
+        out += len(v).to_bytes(4, "little")
+        out += v
+    elif t is list or t is tuple:
+        if depth >= 6 or len(v) > 255:
+            raise _FastUnsupported
+        out.append(_FT_LIST)
+        out.append(len(v))
+        for item in v:
+            _fast_pack_value(out, item, depth + 1)
+    elif t is dict:
+        if depth >= 6 or len(v) > 255:
+            raise _FastUnsupported
+        out.append(_FT_DICT)
+        out.append(len(v))
+        for k, item in v.items():
+            if type(k) is not str:
+                raise _FastUnsupported
+            _fast_str16(out, k)
+            _fast_pack_value(out, item, depth + 1)
+    else:
+        # exact-type dispatch on purpose: np scalars, Exceptions,
+        # ndarrays, ExtType, user subclasses all land here -> full codec
+        raise _FastUnsupported
+
+
+def encode_fast(
+    msg: dict,
+    limit: int = FAST_THRESHOLD_DEFAULT,
+    scratch: Optional[bytearray] = None,
+) -> Optional[bytes]:
+    """Encode ``msg`` as one BEFS frame, or return None when it is not
+    fast-eligible (caller falls back to the full codec).
+
+    Only the two hot envelopes qualify — a CALL without a trace
+    attachment and a RESULT without piggybacked spans — and only when
+    every value packs into the tag scheme above and the whole frame
+    stays within ``limit`` bytes. ``scratch`` is a reusable per-
+    connection buffer; the returned value is an immutable copy so the
+    scratch can be reused immediately (websocket sends may be queued).
+    """
+    try:
+        t = msg.get("t")
+        if t == CALL:
+            if len(msg) != 6:
+                return None
+            return encode_fast_call(
+                msg["call_id"],
+                msg["service_id"],
+                msg["method"],
+                msg["args"],
+                msg["kwargs"],
+                limit,
+                scratch,
+            )
+        if t == RESULT:
+            if len(msg) != 3:
+                return None
+            return encode_fast_result(
+                msg["call_id"], msg["result"], limit, scratch
+            )
+        return None
+    except KeyError:
+        return None
+
+
+def encode_fast_call(
+    call_id: str,
+    service_id: str,
+    method: str,
+    args,
+    kwargs: dict,
+    limit: int = FAST_THRESHOLD_DEFAULT,
+    scratch: Optional[bytearray] = None,
+) -> Optional[bytes]:
+    """``encode_fast`` for a CALL, taken directly from the call-site
+    arguments — the request hot path skips building (and immediately
+    re-walking) the envelope dict entirely. Byte-identical to encoding
+    the equivalent dict through ``encode_fast``."""
+    try:
+        if (
+            type(call_id) is not str
+            or type(service_id) is not str
+            or type(method) is not str
+            or (type(args) is not list and type(args) is not tuple)
+            or type(kwargs) is not dict
+            or len(args) > 255
+            or len(kwargs) > 255
+        ):
+            return None
+        out = scratch if scratch is not None else bytearray()
+        del out[:]
+        out += _FAST_CALL_PREFIX
+        _fast_str16(out, call_id)
+        _fast_str16(out, service_id)
+        _fast_str16(out, method)
+        out.append(len(args))
+        for v in args:
+            _fast_pack_value(out, v, 0)
+        out.append(len(kwargs))
+        for k, v in kwargs.items():
+            if type(k) is not str:
+                return None
+            _fast_str16(out, k)
+            _fast_pack_value(out, v, 0)
+        if len(out) > limit:
+            return None
+        return bytes(out)
+    except (_FastUnsupported, struct.error, OverflowError):
+        return None
+
+
+def encode_fast_result(
+    call_id: str,
+    result: Any,
+    limit: int = FAST_THRESHOLD_DEFAULT,
+    scratch: Optional[bytearray] = None,
+) -> Optional[bytes]:
+    """``encode_fast`` for a RESULT, taken directly from the handler's
+    return value — same direct-argument shortcut as
+    ``encode_fast_call``."""
+    try:
+        if type(call_id) is not str:
+            return None
+        out = scratch if scratch is not None else bytearray()
+        del out[:]
+        out += _FAST_RESULT_PREFIX
+        _fast_str16(out, call_id)
+        _fast_pack_value(out, result, 0)
+        if len(out) > limit:
+            return None
+        return bytes(out)
+    except (_FastUnsupported, struct.error, OverflowError):
+        return None
+
+
+def _fast_read_str16(buf: bytes, pos: int):
+    n = _UNPACK_H(buf, pos)[0]  # no slice allocation on the hot path
+    pos += 2
+    end = pos + n
+    return str(buf[pos:end], "utf-8"), end
+
+
+def _fast_read_value(buf: bytes, pos: int):
+    tag = buf[pos]
+    pos += 1
+    if tag == _FT_NONE:
+        return None, pos
+    if tag == _FT_TRUE:
+        return True, pos
+    if tag == _FT_FALSE:
+        return False, pos
+    if tag == _FT_INT:
+        return _UNPACK_Q(buf, pos)[0], pos + 8
+    if tag == _FT_FLOAT:
+        return _UNPACK_D(buf, pos)[0], pos + 8
+    if tag == _FT_STR:
+        n = _UNPACK_I(buf, pos)[0]
+        pos += 4
+        end = pos + n
+        return str(buf[pos:end], "utf-8"), end
+    if tag == _FT_BYTES:
+        n = _UNPACK_I(buf, pos)[0]
+        pos += 4
+        end = pos + n
+        return buf[pos:end], end
+    if tag == _FT_LIST:
+        n = buf[pos]
+        pos += 1
+        out = []
+        for _ in range(n):
+            v, pos = _fast_read_value(buf, pos)
+            out.append(v)
+        return out, pos
+    if tag == _FT_DICT:
+        n = buf[pos]
+        pos += 1
+        d = {}
+        for _ in range(n):
+            k, pos = _fast_read_str16(buf, pos)
+            v, pos = _fast_read_value(buf, pos)
+            d[k] = v
+        return d, pos
+    raise ValueError(f"bad fast-frame value tag {tag}")
+
+
+def decode_fast(data) -> dict:
+    """Decode a BEFS frame back into the canonical message dict —
+    identical in shape and value to what ``decode`` would return for
+    the same message through the legacy codec (tuples become lists in
+    both, matching msgpack)."""
+    buf = bytes(data)
+    if buf[:4] != FAST_MAGIC:
+        raise ValueError("not a fast frame")
+    kind = buf[4]
+    pos = 5
+    if kind == FAST_KIND_CALL:
+        call_id, service_id, method, args, kwargs = decode_fast_call(buf)
+        return {
+            "t": CALL,
+            "call_id": call_id,
+            "service_id": service_id,
+            "method": method,
+            "args": args,
+            "kwargs": kwargs,
+        }
+    if kind == FAST_KIND_RESULT:
+        call_id, pos = _fast_read_str16(buf, pos)
+        v, pos = _fast_read_value(buf, pos)
+        return {"t": RESULT, "call_id": call_id, "result": v}
+    raise ValueError(f"bad fast-frame kind {kind}")
+
+
+def decode_fast_call(data) -> Optional[tuple]:
+    """``(call_id, service_id, method, args, kwargs)`` for a BEFS CALL
+    frame, None for any other kind — the server's inline dispatch runs
+    the handler straight off the tuple without materializing the
+    envelope dict."""
+    buf = bytes(data)
+    if buf[4] != FAST_KIND_CALL:  # caller already checked the magic
+        return None
+    call_id, pos = _fast_read_str16(buf, 5)
+    service_id, pos = _fast_read_str16(buf, pos)
+    method, pos = _fast_read_str16(buf, pos)
+    n = buf[pos]
+    pos += 1
+    args = []
+    for _ in range(n):
+        v, pos = _fast_read_value(buf, pos)
+        args.append(v)
+    n = buf[pos]
+    pos += 1
+    kwargs = {}
+    for _ in range(n):
+        k, pos = _fast_read_str16(buf, pos)
+        v, pos = _fast_read_value(buf, pos)
+        kwargs[k] = v
+    return call_id, service_id, method, args, kwargs
+
+
+def decode_fast_result(data) -> Optional[tuple]:
+    """``(call_id, value)`` for a BEFS RESULT frame, None for any
+    other kind (the caller falls back to ``decode_fast``). The waiting
+    future gets the value directly — no envelope dict is materialized
+    on the response hot path."""
+    buf = bytes(data)
+    if buf[4] != FAST_KIND_RESULT:  # caller already checked the magic
+        return None
+    call_id, pos = _fast_read_str16(buf, 5)
+    v, _ = _fast_read_value(buf, pos)
+    return call_id, v

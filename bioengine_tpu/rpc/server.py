@@ -20,6 +20,7 @@ Capabilities:
 from __future__ import annotations
 
 import asyncio
+import os
 import secrets
 import time
 import uuid
@@ -100,9 +101,16 @@ class RpcServer:
         token_ttl_seconds: float = 3600 * 24,
         shm_store: Any = "auto",
         transport_config: Optional[TransportConfig] = None,
+        inline_dispatch: Optional[bool] = None,
+        uds_path: Optional[str] = None,
     ):
         self.host = host
         self.port = port
+        # optional same-host listener: serving the same /ws endpoint on
+        # a unix-domain socket skips the TCP stack — the cheap wire for
+        # co-located workers (clients dial ``unix://<path>``)
+        self.uds_path = uds_path
+        self._uds_site: Optional[web.UnixSite] = None
         self.default_workspace = default_workspace
         self.admin_users = list(admin_users or [])
         self.token_ttl_seconds = token_ttl_seconds
@@ -129,6 +137,16 @@ class RpcServer:
         self._client_protos: dict[str, frozenset[str]] = {}
         self._shm_store_cfg = shm_store
         self._shm_store: Any = None
+        # microsecond hot path: an untraced CALL whose target is a
+        # LOCAL sync method is executed inline from the read loop —
+        # no asyncio task per request (~10-20us saved per call).
+        # (service_id, method) -> eligible; cleared on (un)register.
+        self._inline_sync: dict[tuple, bool] = {}
+        self._inline_dispatch = (
+            inline_dispatch
+            if inline_dispatch is not None
+            else os.environ.get("BIOENGINE_RPC_INLINE_DISPATCH", "1") != "0"
+        )
         self._shm_nonces: dict[str, tuple[str, bytes]] = {}  # client -> (key, nonce)
         # controller fencing epoch (set by ServeController.attach_rpc):
         # advertised in the welcome so a connecting host can spot a
@@ -198,6 +216,14 @@ class RpcServer:
         self._site = web.TCPSite(self._runner, self.host, self.port)
         await self._site.start()
         self.port = self._site._server.sockets[0].getsockname()[1]
+        if self.uds_path:
+            try:
+                os.unlink(self.uds_path)  # stale socket from a crash
+            except OSError:
+                pass
+            self._uds_site = web.UnixSite(self._runner, self.uds_path)
+            await self._uds_site.start()
+            self.logger.info(f"RPC server also on unix://{self.uds_path}")
         self.logger.info(f"RPC server listening on ws://{self.host}:{self.port}/ws")
         return self.url
 
@@ -206,6 +232,11 @@ class RpcServer:
             await ws.close()
         if self._runner:
             await self._runner.cleanup()
+        if self.uds_path:
+            try:
+                os.unlink(self.uds_path)
+            except OSError:
+                pass
         for codec in self._client_codecs.values():
             codec.close()
         self._client_codecs.clear()
@@ -219,6 +250,7 @@ class RpcServer:
         chunked sends, encode/decode seconds, shm hit-rate)."""
         d = {
             "url": self.url,
+            "uds_path": self.uds_path,
             "services": len(self._services),
             "clients": len(self._clients),
             "transport": self.stats.as_dict(),
@@ -330,11 +362,13 @@ class RpcServer:
             },
         )
         self._services[entry.full_id] = entry
+        self._inline_sync.clear()
         self.logger.info(f"Registered local service {entry.full_id}")
         return entry
 
     def unregister_service(self, full_id: str) -> None:
         self._services.pop(full_id, None)
+        self._inline_sync.clear()
 
     def service_peer_supports(self, full_id: str, capability: str) -> bool:
         """Did the ws client that OWNS ``full_id`` declare ``capability``
@@ -405,8 +439,12 @@ class RpcServer:
             fn = entry.methods.get(method)
             if fn is None:
                 raise AttributeError(f"{full_id} has no method '{method}'")
-            with tracing.trace_span(
-                "rpc.dispatch", service=full_id, method=method
+            # gate the attr-dict build on the sampled check — this
+            # runs once per local dispatch on the unsampled hot path
+            with (
+                tracing.span("rpc.dispatch", service=full_id, method=method)
+                if tracing.sampled()
+                else tracing.NOOP_SPAN
             ):
                 result = fn(*args, **kwargs)
                 if asyncio.iscoroutine(result):
@@ -436,8 +474,10 @@ class RpcServer:
         if codec is not None and codec.trace and ctx is not None and ctx.sampled:
             msg["trace"] = ctx.to_wire()
         try:
-            with tracing.trace_span(
-                "rpc.call", service=full_id, method=method
+            with (
+                tracing.span("rpc.call", service=full_id, method=method)
+                if tracing.sampled()
+                else tracing.NOOP_SPAN
             ):
                 await self._send(ws, codec, msg)
                 return await asyncio.wait_for(fut, timeout)
@@ -617,6 +657,13 @@ class RpcServer:
             await faults.hit("rpc.server.send", drop=ws.close)
         if codec is None:
             codec = Codec(config=self.transport_config, stats=self.stats)
+        if codec.fast:
+            # small-response hot path: one sync encode attempt, one
+            # send — skips the coroutine + payload walk when it hits
+            frame = codec.encode_fast_frame(msg)
+            if frame is not None:
+                await ws.send_bytes(frame)
+                return
         for frame in await codec.encode_frames_async(msg):
             await ws.send_bytes(frame)
 
@@ -641,6 +688,7 @@ class RpcServer:
         declared = request.query.get("proto", "").split(",")
         codec.oob = protocol.PROTO_OOB1 in declared
         codec.trace = protocol.PROTO_TRACE1 in declared
+        codec.fast = protocol.PROTO_FAST1 in declared
         self._clients[client_id] = ws
         # the full declared set outlives the codec flags: server-side
         # capability gates (e.g. the controller refusing to plan a
@@ -660,6 +708,7 @@ class RpcServer:
                 protocol.PROTO_TELEM1,
                 protocol.PROTO_MESH1,
                 protocol.PROTO_EPOCH1,
+                protocol.PROTO_FAST1,
             ],
         }
         if self.epoch is not None:
@@ -684,17 +733,55 @@ class RpcServer:
             async for msg in ws:
                 if msg.type != WSMsgType.BINARY:
                     continue
+                raw = msg.data
                 try:
-                    decoded = await codec.decode_async(msg.data)
-                    if decoded is None:
-                        continue  # mid-reassembly chunk
-                    await self._dispatch(client_id, ws, decoded)
+                    if protocol.is_fast_frame(raw):
+                        # BEFS: sync decode, nothing pinned to drain.
+                        # A fast frame is only ever CALL or RESULT and
+                        # a fast CALL can never carry a trace
+                        # attachment (the encoder rejects it), so the
+                        # inline gate here is just the memoized plan —
+                        # and the hot path runs handler-from-tuple
+                        # without ever materializing the envelope dict
+                        parsed = (
+                            codec.decode_fast_call_frame(raw)
+                            if self._inline_dispatch
+                            else None
+                        )
+                        if parsed is not None:
+                            call_id, sid, mth, c_args, c_kwargs = parsed
+                            plan = self._inline_call_plan(sid, mth)
+                            if plan:
+                                await self._handle_call_inline(
+                                    ws, codec, info,
+                                    call_id, sid, c_args, c_kwargs,
+                                    plan,
+                                )
+                                continue
+                            await self._dispatch(client_id, ws, {
+                                "t": protocol.CALL,
+                                "call_id": call_id,
+                                "service_id": sid,
+                                "method": mth,
+                                "args": c_args,
+                                "kwargs": c_kwargs,
+                            })
+                            continue
+                        await self._dispatch(
+                            client_id, ws, codec.decode_fast_frame(raw)
+                        )
+                        continue
+                    try:
+                        decoded = await codec.decode_async(raw)
+                        if decoded is None:
+                            continue  # mid-reassembly chunk
+                        await self._dispatch(client_id, ws, decoded)
+                    finally:
+                        # one-shot shm payloads whose consumers
+                        # finished leave the arena as soon as possible
+                        codec.drain_pins()
                 except Exception as e:  # keep the connection alive
                     self.logger.error(f"dispatch error: {e}")
-                finally:
-                    # one-shot shm payloads whose consumers finished
-                    # leave the arena as soon as possible
-                    codec.drain_pins()
         finally:
             self._drop_client(client_id)
         return ws
@@ -718,6 +805,7 @@ class RpcServer:
             if e.owner_client == client_id
         ]:
             del self._services[full_id]
+            self._inline_sync.clear()
             self.logger.info(f"Dropped service {full_id} (client disconnect)")
         # fail every in-flight call routed to this client NOW — without
         # this, callers hang for the full RPC timeout after a provider
@@ -740,7 +828,35 @@ class RpcServer:
         t = msg.get("t")
         info = self._client_users[client_id]
         codec = self._client_codecs.get(client_id)
-        if t == protocol.PING:
+        if t == protocol.CALL:
+            # checked first — CALL dominates the message mix.
+            # Uncontended small-request path: a sync local handler runs
+            # for ~microseconds either way — spawning a supervised task
+            # just to host it costs more than the call itself. Inline
+            # keeps ordering per connection (the read loop is already
+            # sequential); async handlers and remote providers still
+            # take the task path so pipelined calls interleave.
+            plan = (
+                self._inline_dispatch
+                and "trace" not in msg
+                and self._inline_call_plan(
+                    msg.get("service_id"), msg.get("method")
+                )
+            )
+            if plan:
+                await self._handle_call_inline(
+                    ws, codec, info,
+                    msg.get("call_id"), msg.get("service_id"),
+                    msg.get("args", ()), msg.get("kwargs") or {},
+                    plan,
+                )
+            else:
+                spawn_supervised(
+                    self._handle_call(ws, codec, info, msg),
+                    name="rpc-handle-call",
+                    logger=self.logger,
+                )
+        elif t == protocol.PING:
             await self._send(ws, codec, {"t": protocol.PONG, "ts": time.time()})
         elif t == protocol.SHM_ACK:
             # the client read the probe nonce out of the segment and
@@ -784,6 +900,7 @@ class RpcServer:
                 schemas=definition.get("methods", {}),
             )
             self._services[entry.full_id] = entry
+            self._inline_sync.clear()
             await self._send(
                 ws,
                 codec,
@@ -797,6 +914,7 @@ class RpcServer:
             entry = self._services.get(msg["service_id"])
             if entry and entry.owner_client == client_id:
                 del self._services[msg["service_id"]]
+                self._inline_sync.clear()
             await self._send(
                 ws,
                 codec,
@@ -839,12 +957,6 @@ class RpcServer:
                     "result": self.list_services(msg.get("workspace")),
                 },
             )
-        elif t == protocol.CALL:
-            spawn_supervised(
-                self._handle_call(ws, codec, info, msg),
-                name="rpc-handle-call",
-                logger=self.logger,
-            )
         elif t == protocol.RESULT:
             if msg.get("spans"):
                 # spans a provider recorded while serving a sampled
@@ -863,6 +975,86 @@ class RpcServer:
                 if not isinstance(err, Exception):
                     err = RuntimeError(str(err))
                 fut.set_exception(err)
+
+    def _inline_call_plan(self, service_id, method):
+        """Resolve a CALL target to a (fn, require_context, protected)
+        plan when it is a local (in-process) plain-function method,
+        else False. Memoized per (service_id, method) — the lookup
+        runs on every request, so it must cost two dict hits, not an
+        ``iscoroutinefunction`` + config walk. Any registry mutation
+        clears the memo."""
+        key = (service_id, method)
+        plan = self._inline_sync.get(key)
+        if plan is None:
+            entry = self._services.get(service_id)
+            fn = (
+                entry.methods.get(method)
+                if entry is not None and entry.owner_client is None
+                else None
+            )
+            if fn is None or asyncio.iscoroutinefunction(fn):
+                plan = False
+            else:
+                cfg = entry.definition.get("config", {})
+                plan = (
+                    fn,
+                    bool(cfg.get("require_context", False)),
+                    cfg.get("visibility", "public") == "protected",
+                )
+            self._inline_sync[key] = plan
+        return plan
+
+    async def _handle_call_inline(
+        self,
+        ws: web.WebSocketResponse,
+        codec: Optional[Codec],
+        info: TokenInfo,
+        call_id,
+        service_id,
+        args,
+        kwargs: dict,
+        plan: tuple,
+    ) -> None:
+        """The microsecond dispatch path for an untraced CALL whose
+        target resolved to a local sync method: the permission and
+        context rules of ``call_service_method`` applied from the
+        memoized plan, no span machinery (nothing is sampled here —
+        the inline branch requires an untraced CALL), no pin drain
+        (small frames carry no shm refs). Takes the envelope fields
+        unpacked so the BEFS read-loop path never builds the dict."""
+        fn, require_context, protected = plan
+        try:
+            if protected and not info.is_admin:
+                raise PermissionError(
+                    f"service '{service_id}' is protected "
+                    "(admin required)"
+                )
+            if require_context:
+                kwargs = dict(kwargs)
+                kwargs["context"] = self._context_for(info)
+            result = fn(*args, **kwargs)
+            if asyncio.iscoroutine(result):
+                result = await result
+            if codec is not None and codec.fast:
+                # straight from return value to wire: no RESULT dict
+                # unless the fast encode bails (oversize payload)
+                if faults.ACTIVE:
+                    await faults.hit("rpc.server.send", drop=ws.close)
+                frame = codec.encode_fast_result_frame(call_id, result)
+                if frame is not None:
+                    await ws.send_bytes(frame)
+                    return
+            await self._send(
+                ws,
+                codec,
+                {
+                    "t": protocol.RESULT,
+                    "call_id": call_id,
+                    "result": result,
+                },
+            )
+        except Exception as e:
+            await self._send_error(ws, codec, call_id, e)
 
     async def _handle_call(
         self,

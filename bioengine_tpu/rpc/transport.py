@@ -39,6 +39,10 @@ import msgpack
 from bioengine_tpu.rpc import protocol
 from bioengine_tpu.utils import metrics
 
+# module-level bind: a global-name load beats two attribute hops on a
+# function called four times per small-request round trip
+_perf_counter = time.perf_counter
+
 
 def _env_mb(name: str, default_mb: float) -> int:
     return int(float(os.environ.get(name, default_mb)) * 1024 * 1024)
@@ -64,6 +68,9 @@ class TransportConfig:
     # the per-websocket-message cap, so this is the replacement bound
     # on what a peer's chunk headers can make the receiver allocate
     max_assembled: int = 2 * 1024 * 1024 * 1024
+    # whole-frame byte ceiling for BEFS small-request fast frames; a
+    # message that packs larger falls back to the full codec
+    fast_threshold: int = protocol.FAST_THRESHOLD_DEFAULT
 
     def __post_init__(self) -> None:
         # a chunk (frame_limit payload + ~64-byte header) must fit the
@@ -82,6 +89,14 @@ class TransportConfig:
             offload_threshold=_env_mb("BIOENGINE_RPC_OFFLOAD_MB", 4),
             max_msg_size=_env_mb("BIOENGINE_RPC_MAX_MSG_MB", 256),
             max_assembled=_env_mb("BIOENGINE_RPC_MAX_ASSEMBLED_MB", 2048),
+            fast_threshold=int(
+                float(
+                    os.environ.get(
+                        "BIOENGINE_RPC_FAST_THRESHOLD",
+                        protocol.FAST_THRESHOLD_DEFAULT,
+                    )
+                )
+            ),
         )
 
 
@@ -119,6 +134,13 @@ class RpcStats:
     # shards must land here, never as legacy inline double-packs.
     oob_payloads_out: int = 0
     oob_payload_bytes_out: int = 0
+    # BEFS small-request fast frames. fast_fallbacks counts CALL/RESULT
+    # envelopes on a fast1 connection that still needed the full codec
+    # (trace attached, spans piggybacked, oversize or non-scalar args)
+    # — the hit-rate denominator next to shm_hit_rate.
+    small_frames_out: int = 0
+    small_frames_in: int = 0
+    fast_fallbacks: int = 0
 
     def __post_init__(self) -> None:
         # every live stats object feeds the process-wide metrics plane
@@ -136,6 +158,12 @@ class RpcStats:
         d["shm_hit_rate"] = (
             round(d["shm_puts"] / shm_total, 4) if shm_total else None
         )
+        fast_total = d["small_frames_out"] + d["fast_fallbacks"]
+        d["fast_frame_hit_rate"] = (
+            round(d["small_frames_out"] / fast_total, 4)
+            if fast_total
+            else None
+        )
         return d
 
 
@@ -145,6 +173,7 @@ _RPC_METRIC_FIELDS = (
     "decode_seconds", "shm_puts", "shm_put_bytes", "shm_gets",
     "shm_get_bytes", "shm_fallbacks", "legacy_msgs_out",
     "oob_payloads_out", "oob_payload_bytes_out",
+    "small_frames_out", "small_frames_in", "fast_fallbacks",
 )
 
 
@@ -173,6 +202,28 @@ def _collect_rpc_stats(instances: list) -> list:
             len(instances),
             kind="gauge",
             help="live RpcStats objects (server + client connections)",
+        )
+    )
+    small_total = totals["small_frames_out"] + totals["small_frames_in"]
+    samples.append(
+        metrics.Sample(
+            "rpc_small_frames_total",
+            round(small_total, 4),
+            kind="counter",
+            help="BEFS fast frames on the wire, both directions "
+            "(process total)",
+        )
+    )
+    fast_attempts = totals["small_frames_out"] + totals["fast_fallbacks"]
+    samples.append(
+        metrics.Sample(
+            "rpc_fast_frame_hit_rate",
+            round(totals["small_frames_out"] / fast_attempts, 4)
+            if fast_attempts
+            else 0.0,
+            kind="gauge",
+            help="fraction of fast1 CALL/RESULT envelopes that rode a "
+            "BEFS frame instead of the full codec",
         )
     )
     return samples
@@ -385,6 +436,12 @@ class ShmPinTracker:
         self.drain()
 
 
+# a scratch bytearray that ballooned past this is dropped instead of
+# returned to the pool — one aborted pack of a 64 KB string must not
+# pin that much capacity on the connection forever
+_FAST_SCRATCH_RETAIN = 2 * protocol.FAST_THRESHOLD_DEFAULT + 65536
+
+
 class Codec:
     """Per-connection encoder/decoder with negotiated capabilities."""
 
@@ -398,11 +455,20 @@ class Codec:
         self.stats = stats or RpcStats()
         self.oob = False                 # peer speaks PROTO_OOB1
         self.trace = False               # peer speaks PROTO_TRACE1
+        self.fast = False                # peer speaks PROTO_FAST1
         self.shm_store = None            # negotiated same-host store
         self._tracker: Optional[ShmPinTracker] = None
         self._assembler = FrameAssembler(
             max_assembled=self.config.max_assembled
         )
+        # reusable BEFS scratch buffers. A tiny pool (list.pop/append
+        # are atomic under the GIL) instead of one shared bytearray:
+        # encode can run concurrently on the event loop and in an
+        # offload thread, and a scratch must never be shared mid-pack
+        self._fast_pool: list[bytearray] = [bytearray()]
+        # hoisted out of the per-frame wrappers: two attribute hops per
+        # call add up at 4 codec invocations per round trip
+        self._fast_threshold = self.config.fast_threshold
 
     # ---- negotiation --------------------------------------------------------
 
@@ -429,8 +495,96 @@ class Codec:
             self.stats.shm_put_bytes += buf.nbytes
         return key
 
+    def encode_fast_frame(self, msg: dict) -> Optional[bytes]:
+        """One BEFS frame for a fast-eligible message, else None (and
+        the fallback counter ticks for the hot envelopes).
+
+        Stats are updated WITHOUT the lock: fast frames are by
+        construction small, so this path only ever runs on the event
+        loop thread (the ``to_thread`` offload is for big payloads,
+        which can never qualify). The counters are advisory — a lost
+        increment against a concurrent locked full-path update is
+        tolerable; a per-request lock acquire on the microsecond hot
+        path is not (BE-PERF-301)."""
+        t0 = _perf_counter()
+        pool = self._fast_pool
+        scratch = pool.pop() if pool else bytearray()
+        frame = protocol.encode_fast(msg, self._fast_threshold, scratch)
+        if len(scratch) <= _FAST_SCRATCH_RETAIN:
+            pool.append(scratch)
+        st = self.stats
+        if frame is None:
+            t = msg.get("t")
+            if t == protocol.CALL or t == protocol.RESULT:
+                st.fast_fallbacks += 1
+            return None
+        st.small_frames_out += 1
+        st.encode_seconds += _perf_counter() - t0
+        st.msgs_out += 1
+        st.frames_out += 1
+        st.bytes_out += len(frame)
+        return frame
+
+    def encode_fast_call_frame(
+        self, call_id: str, service_id: str, method: str, args, kwargs: dict
+    ) -> Optional[bytes]:
+        """``encode_fast_frame`` from call-site arguments — the client
+        request path never materializes the CALL dict when this hits
+        (same unlocked-stats argument, BE-PERF-301)."""
+        t0 = _perf_counter()
+        pool = self._fast_pool
+        scratch = pool.pop() if pool else bytearray()
+        frame = protocol.encode_fast_call(
+            call_id, service_id, method, args, kwargs,
+            self._fast_threshold, scratch,
+        )
+        if len(scratch) <= _FAST_SCRATCH_RETAIN:
+            pool.append(scratch)
+        st = self.stats
+        if frame is None:
+            st.fast_fallbacks += 1
+            return None
+        st.small_frames_out += 1
+        st.encode_seconds += _perf_counter() - t0
+        st.msgs_out += 1
+        st.frames_out += 1
+        st.bytes_out += len(frame)
+        return frame
+
+    def encode_fast_result_frame(
+        self, call_id: str, result: Any
+    ) -> Optional[bytes]:
+        """``encode_fast_frame`` from the handler's return value — the
+        server inline-dispatch path never materializes the RESULT
+        dict when this hits."""
+        t0 = _perf_counter()
+        pool = self._fast_pool
+        scratch = pool.pop() if pool else bytearray()
+        frame = protocol.encode_fast_result(
+            call_id, result, self._fast_threshold, scratch
+        )
+        if len(scratch) <= _FAST_SCRATCH_RETAIN:
+            pool.append(scratch)
+        st = self.stats
+        if frame is None:
+            st.fast_fallbacks += 1
+            return None
+        st.small_frames_out += 1
+        st.encode_seconds += _perf_counter() - t0
+        st.msgs_out += 1
+        st.frames_out += 1
+        st.bytes_out += len(frame)
+        return frame
+
     def encode_frames(self, msg: dict) -> list:
         """Encode ``msg`` into the list of websocket messages to send."""
+        if self.fast:
+            frame = self.encode_fast_frame(msg)
+            if frame is not None:
+                return [frame]
+        return self._encode_full(msg)
+
+    def _encode_full(self, msg: dict) -> list:
         t0 = time.perf_counter()
         payload_info: dict = {}
         if not self.oob:
@@ -456,9 +610,16 @@ class Codec:
     async def encode_frames_async(self, msg: dict) -> list:
         """``encode_frames``, off-loop when the payload is large enough
         that serializing it inline would stall the event loop."""
+        if self.fast:
+            # the fast attempt is bounded (bails on the first oversize
+            # or non-scalar value) so it runs inline and, when it hits,
+            # skips the payload_nbytes walk entirely
+            frame = self.encode_fast_frame(msg)
+            if frame is not None:
+                return [frame]
         if protocol.payload_nbytes(msg) >= self.config.offload_threshold:
-            return await asyncio.to_thread(self.encode_frames, msg)
-        return self.encode_frames(msg)
+            return await asyncio.to_thread(self._encode_full, msg)
+        return self._encode_full(msg)
 
     # ---- decode -------------------------------------------------------------
 
@@ -481,7 +642,13 @@ class Codec:
                 self.stats.bytes_in += len(data)
                 self.stats.decode_seconds += time.perf_counter() - t0
             return None
-        if protocol.is_oob_frame(whole):
+        fast_in = False
+        if protocol.is_fast_frame(whole):
+            # dispatch by magic, not by the negotiated flag: only a
+            # fast1 peer ever sends BEFS, but decode stays symmetric
+            msg = protocol.decode_fast(whole)
+            fast_in = True
+        elif protocol.is_oob_frame(whole):
             msg = protocol.decode_oob(
                 whole,
                 shm_get=self._shm_materialize
@@ -495,9 +662,61 @@ class Codec:
             self.stats.bytes_in += len(data)
             if whole is not data:
                 self.stats.chunked_msgs_in += 1
+            if fast_in:
+                self.stats.small_frames_in += 1
             self.stats.msgs_in += 1
             self.stats.decode_seconds += time.perf_counter() - t0
         return msg
+
+    def decode_fast_frame(self, data: bytes) -> dict:
+        """Decode one BEFS frame (caller checked ``is_fast_frame``).
+        BEFS frames are never chunked and never big enough to offload,
+        so the read loops take this branch-free sync path — no
+        assembler feed, no coroutine, and (same argument as
+        ``encode_fast_frame``) no stats lock."""
+        t0 = _perf_counter()
+        msg = protocol.decode_fast(data)
+        st = self.stats
+        st.frames_in += 1
+        st.bytes_in += len(data)
+        st.small_frames_in += 1
+        st.msgs_in += 1
+        st.decode_seconds += _perf_counter() - t0
+        return msg
+
+    def decode_fast_call_frame(self, data: bytes) -> Optional[tuple]:
+        """``(call_id, service_id, method, args, kwargs)`` for a BEFS
+        CALL frame, else None — the server's inline dispatch runs off
+        the tuple without building the envelope dict. A None return
+        records no stats; the ``decode_fast_frame`` fallback does."""
+        t0 = _perf_counter()
+        parsed = protocol.decode_fast_call(data)
+        if parsed is None:
+            return None
+        st = self.stats
+        st.frames_in += 1
+        st.bytes_in += len(data)
+        st.small_frames_in += 1
+        st.msgs_in += 1
+        st.decode_seconds += _perf_counter() - t0
+        return parsed
+
+    def decode_fast_result_frame(self, data: bytes) -> Optional[tuple]:
+        """``(call_id, value)`` for a BEFS RESULT frame, else None —
+        the client read loop resolves the waiting future from the
+        tuple without building the envelope dict. A None return
+        records no stats; the ``decode_fast_frame`` fallback does."""
+        t0 = _perf_counter()
+        parsed = protocol.decode_fast_result(data)
+        if parsed is None:
+            return None
+        st = self.stats
+        st.frames_in += 1
+        st.bytes_in += len(data)
+        st.small_frames_in += 1
+        st.msgs_in += 1
+        st.decode_seconds += _perf_counter() - t0
+        return parsed
 
     async def decode_async(self, data) -> Optional[dict]:
         if len(data) >= self.config.offload_threshold:

@@ -371,6 +371,10 @@ class DeploymentHandle:
         self._m_e2e: dict[str, Any] = {}       # method -> histogram child
         self._m_outcomes: dict[str, Any] = {}  # outcome -> counter child
         self._m_hedges: dict[str, Any] = {}    # winner -> counter child
+        # prebuilt span-attr template: the route span's attrs never
+        # change for a handle, so the unsampled hot path must not
+        # allocate a kwargs dict per request just to throw it away
+        self._ts_route = {"app": app_id, "deployment": deployment}
 
     def with_options(self, options: RequestOptions) -> "DeploymentHandle":
         """A sibling handle whose calls default to ``options``."""
@@ -529,9 +533,7 @@ class DeploymentHandle:
             replica = None
             if scheduler is None:
                 t_route = time.monotonic()
-                with tracing.trace_span(
-                    "route", app=self.app_id, deployment=self.deployment
-                ):
+                with tracing.trace_span_t("route", self._ts_route):
                     replica = await self._controller._pick_replica_wait(
                         self.app_id, self.deployment, avoid=tried,
                         deadline=deadline,
@@ -566,10 +568,18 @@ class DeploymentHandle:
                         budget, deadline, tried, attempt,
                     )
                     return result
-                with tracing.trace_span(
-                    "attempt",
-                    replica=replica.replica_id if replica else "scheduler",
-                    attempt=attempt,
+                # attempt attrs vary per call — gate the kwargs-dict
+                # build on the sampled check instead of templating
+                with (
+                    tracing.span(
+                        "attempt",
+                        replica=replica.replica_id
+                        if replica
+                        else "scheduler",
+                        attempt=attempt,
+                    )
+                    if tracing.sampled()
+                    else tracing.NOOP_SPAN
                 ):
                     if scheduler is None:
                         t_attempt = time.monotonic()

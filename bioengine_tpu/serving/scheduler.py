@@ -182,14 +182,29 @@ def _sig_value(v: Any) -> Hashable:
     return type(v).__name__
 
 
+# sorted-kwargs-key memo: the signature is rebuilt on EVERY submit, and
+# re-sorting the same handful of kwarg-key tuples re-serializes scalar
+# kwargs for no reason (the --hot-path-report offender PR 16 mapped).
+# Keyed by the kwargs keys IN INSERTION ORDER — handles call with a
+# stable shape, so this hits ~always. Bounded; eviction is arbitrary.
+_SORTED_KEYS_CACHE: dict[tuple, tuple] = {}
+_SORTED_KEYS_CACHE_MAX = 512
+
+
 def batch_signature(method: str, args: tuple, kwargs: dict) -> Hashable:
     """Controller-side compatibility key: requests sharing a signature
     may ride one dispatched group (the same replica, one round trip)."""
-    return (
-        method,
-        tuple(_sig_value(a) for a in args),
-        tuple((k, _sig_value(kwargs[k])) for k in sorted(kwargs)),
-    )
+    if kwargs:
+        keys = tuple(kwargs)
+        skeys = _SORTED_KEYS_CACHE.get(keys)
+        if skeys is None:
+            if len(_SORTED_KEYS_CACHE) >= _SORTED_KEYS_CACHE_MAX:
+                _SORTED_KEYS_CACHE.pop(next(iter(_SORTED_KEYS_CACHE)))
+            skeys = _SORTED_KEYS_CACHE[keys] = tuple(sorted(keys))
+        kw_sig = tuple((k, _sig_value(kwargs[k])) for k in skeys)
+    else:
+        kw_sig = ()
+    return (method, tuple(_sig_value(a) for a in args), kw_sig)
 
 
 # ---------------------------------------------------------------------------
@@ -273,7 +288,12 @@ class ReplicaScorer(Protocol):
     """Placement policy contract: lower score wins. ``features`` is the
     stable interface a learned policy consumes — keys: ``load``,
     ``queued``, ``max_ongoing``, ``breaker_failures``,
-    ``signature_affinity``, ``avoided``, ``group_size``."""
+    ``signature_affinity``, ``avoided``, ``probation``, ``group_size``.
+
+    The dict is a REUSED template mutated between ``score`` calls (one
+    allocation per scheduler, not per candidate): read synchronously,
+    copy (``dict(features)``) before retaining for training datasets or
+    deferred scoring."""
 
     def score(self, features: dict) -> float: ...
 
@@ -523,6 +543,17 @@ class DeploymentScheduler:
         self._m_rejected: dict[str, Any] = {}  # reason -> counter child
         self._m_batch = SCHED_BATCH_SIZE.labels(app_id, deployment)
         self._m_dispatch = SCHED_DISPATCHES.labels(app_id, deployment)
+        # reusable scorer feature dict — see _best_replica
+        self._feat_template: dict[str, Any] = {
+            "load": 0,
+            "queued": 0,
+            "max_ongoing": 0,
+            "breaker_failures": 0,
+            "signature_affinity": False,
+            "avoided": False,
+            "probation": False,
+            "group_size": 1,
+        }
         _SCHEDULERS.add(self)
 
     # ---- admission ----------------------------------------------------------
@@ -593,7 +624,7 @@ class DeploymentScheduler:
                     timeout_s, priority, now, probe,
                 )
         self.predictor.note_arrival(now)
-        ctx = tracing.current_trace()
+        ctx, span_id = tracing.current_trace_and_span()
         sampled = ctx is not None and ctx.sampled
         req = _Request(
             method=method,
@@ -608,7 +639,7 @@ class DeploymentScheduler:
             probe=probe,
             future=asyncio.get_running_loop().create_future(),
             trace_ctx=ctx if sampled else None,
-            parent_span=tracing.current_span_id() if sampled else None,
+            parent_span=span_id if sampled else None,
         )
         queue = self._queues[priority]
         # EDF insertion: linear from the back (deadline-free traffic —
@@ -743,23 +774,27 @@ class DeploymentScheduler:
                 return pool[tracker._probe_tick % len(pool)]
         best = None
         best_score = None
+        # one reusable feature dict, mutated per candidate: the scorer
+        # contract is read-synchronously-then-forget (HeuristicCostModel
+        # and any FittedCostModel must copy if they retain — documented
+        # on ReplicaScorer). Building an 8-key dict literal per
+        # candidate per pick was a measurable slice of the uncontended
+        # submit budget.
+        feats = self._feat_template
+        feats["group_size"] = group_size
+        breaker_counts = self.controller._breaker_counts
+        last_sig = self._last_signature
+        score = self.scorer.score
         for r in candidates:
-            s = self.scorer.score(
-                {
-                    "load": r.load,
-                    "queued": getattr(r, "_queued", 0),
-                    "max_ongoing": r.max_ongoing_requests,
-                    "breaker_failures": self.controller._breaker_counts.get(
-                        r.replica_id, 0
-                    ),
-                    "signature_affinity": (
-                        self._last_signature.get(r.replica_id) == signature
-                    ),
-                    "avoided": r.replica_id in avoid,
-                    "probation": r.state == ReplicaState.PROBATION,
-                    "group_size": group_size,
-                }
-            )
+            rid = r.replica_id
+            feats["load"] = r.load
+            feats["queued"] = getattr(r, "_queued", 0)
+            feats["max_ongoing"] = r.max_ongoing_requests
+            feats["breaker_failures"] = breaker_counts.get(rid, 0)
+            feats["signature_affinity"] = last_sig.get(rid) == signature
+            feats["avoided"] = rid in avoid
+            feats["probation"] = r.state == ReplicaState.PROBATION
+            s = score(feats)
             if best_score is None or s < best_score:
                 best, best_score = r, s
         return best

@@ -51,14 +51,17 @@ MAX_SPANS = 4096
 
 _spans: deque[dict] = deque(maxlen=MAX_SPANS)
 _lock = threading.Lock()
-_current: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
-    "bioengine_span", default=None
-)
-_trace: contextvars.ContextVar[Optional["TraceContext"]] = (
-    contextvars.ContextVar("bioengine_trace", default=None)
-)
-_chip: contextvars.ContextVar[Optional["ChipSecondsAccumulator"]] = (
-    contextvars.ContextVar("bioengine_chip_seconds", default=None)
+
+# The whole per-request tracing state rides ONE contextvar holding an
+# immutable (trace_context, current_span_id, chip_accumulator) triple.
+# Contextvar reads are the per-request tax tracing charges even when
+# disabled; fusing the triple means carry()/activate()/to_wire() and
+# the scheduler's submit path pay one read where they used to pay two
+# or three. Every mutation allocates a fresh 3-tuple — cheap, and only
+# sampled requests / chip-accounted executions mutate at all.
+_EMPTY_STATE: tuple = (None, None, None)
+_state: contextvars.ContextVar[tuple] = contextvars.ContextVar(
+    "bioengine_trace_state", default=_EMPTY_STATE
 )
 
 
@@ -97,7 +100,10 @@ class TraceContext:
     def to_wire(self) -> dict:
         """The trace fields carried on a CALL message (only when the
         peer negotiated ``trace1`` and the request is sampled)."""
-        return {"tid": self.trace_id, "sid": _current.get() or self.span_id}
+        return {
+            "tid": self.trace_id,
+            "sid": _state.get()[1] or self.span_id,
+        }
 
     @classmethod
     def from_wire(cls, d: dict) -> "TraceContext":
@@ -172,24 +178,37 @@ def activate(ctx: TraceContext):
     """Install ``ctx`` as the current trace (and its ``span_id`` as the
     current parent, so local spans chain to the remote caller's span).
     Returns an opaque token for :func:`deactivate`."""
-    return (_trace.set(ctx), _current.set(ctx.span_id))
+    chip = _state.get()[2]
+    return _state.set((ctx, ctx.span_id, chip))
 
 
 def deactivate(token) -> None:
-    t_trace, t_span = token
-    _trace.reset(t_trace)
-    _current.reset(t_span)
+    _state.reset(token)
 
 
 def current_trace() -> Optional[TraceContext]:
-    return _trace.get()
+    return _state.get()[0]
 
 
 def current_span_id() -> Optional[str]:
     """The enclosing span's id — for call sites that record a span
     *later* (e.g. the batcher measures queue wait at flush time) and
     must capture the parent while the request is still in scope."""
-    return _current.get()
+    return _state.get()[1]
+
+
+def current_trace_and_span() -> tuple:
+    """The (trace_context, span_id) pair in ONE contextvar read — for
+    hot call sites (scheduler submit) that need both."""
+    st = _state.get()
+    return st[0], st[1]
+
+
+def sampled() -> bool:
+    """True when the current request's trace is sampled — the cheap
+    gate hot call sites use before building span attr dicts."""
+    ctx = _state.get()[0]
+    return ctx is not None and ctx.sampled
 
 
 def carry(ctx: Optional[TraceContext], fn):
@@ -199,25 +218,27 @@ def carry(ctx: Optional[TraceContext], fn):
     automatic contextvar propagation does not reach. Chip accounting
     crosses even for unsampled requests: cost is accounting, not
     sampled telemetry."""
-    acc = _chip.get()
-    sampled = ctx is not None and ctx.sampled
-    if not sampled and acc is None:
+    st = _state.get()
+    acc = st[2]
+    is_sampled = ctx is not None and ctx.sampled
+    if not is_sampled and acc is None:
         return fn
 
-    parent = _current.get()
+    parent = st[1]
 
     def wrapped(*args, **kwargs):
-        tokens = []
-        if sampled:
-            tokens.append((_trace, _trace.set(ctx)))
-            tokens.append((_current, _current.set(parent)))
-        if acc is not None:
-            tokens.append((_chip, _chip.set(acc)))
+        here = _state.get()
+        token = _state.set(
+            (
+                ctx if is_sampled else here[0],
+                parent if is_sampled else here[1],
+                acc if acc is not None else here[2],
+            )
+        )
         try:
             return fn(*args, **kwargs)
         finally:
-            for var, token in reversed(tokens):
-                var.reset(token)
+            _state.reset(token)
 
     return wrapped
 
@@ -244,18 +265,19 @@ def start_chip_accounting() -> tuple[ChipSecondsAccumulator, Any]:
     """Install a fresh accumulator; returns ``(accumulator, token)``
     for :func:`stop_chip_accounting`."""
     acc = ChipSecondsAccumulator()
-    return acc, _chip.set(acc)
+    st = _state.get()
+    return acc, _state.set((st[0], st[1], acc))
 
 
 def stop_chip_accounting(token) -> None:
-    _chip.reset(token)
+    _state.reset(token)
 
 
 def add_chip_seconds(seconds: float) -> None:
     """Engines call this once per prediction: one contextvar read when
     no request accounting is active (engine used outside the serve
     path), one float add when it is."""
-    acc = _chip.get()
+    acc = _state.get()[2]
     if acc is not None and seconds > 0.0:
         acc.seconds += seconds
 
@@ -272,9 +294,9 @@ def span(name: str, **attrs: Any):
     place at close. When a sampled trace context is active the span
     carries its trace_id and feeds the context's collector."""
     span_id = _new_id()
-    parent = _current.get()
-    ctx = _trace.get()
-    token = _current.set(span_id)
+    st = _state.get()
+    ctx, parent = st[0], st[1]
+    token = _state.set((ctx, span_id, st[2]))
     record = {
         "span_id": span_id,
         "parent_id": parent,
@@ -293,7 +315,7 @@ def span(name: str, **attrs: Any):
         record["error"] = f"{type(e).__name__}: {e}"
         raise
     finally:
-        _current.reset(token)
+        _state.reset(token)
         record["duration_s"] = round(time.monotonic() - t0, 6)
         if ctx is not None and ctx.collector is not None:
             ctx.collector.append(record)
@@ -315,13 +337,27 @@ class _NoopSpan:
 _NOOP = _NoopSpan()
 
 
+NOOP_SPAN = _NOOP
+
+
 def trace_span(name: str, **attrs: Any):
     """``span`` gated on the current request being sampled — the
     request-path variant. Control-plane call sites keep ``span``."""
-    ctx = _trace.get()
+    ctx = _state.get()[0]
     if ctx is None or not ctx.sampled:
         return _NOOP
     return span(name, **attrs)
+
+
+def trace_span_t(name: str, attrs_template: dict):
+    """``trace_span`` taking a PREBUILT attr dict — hot call sites keep
+    one template per handle/replica instead of allocating a kwargs dict
+    on every unsampled request. The template is copied when (and only
+    when) the request is sampled, so callers may reuse it freely."""
+    ctx = _state.get()[0]
+    if ctx is None or not ctx.sampled:
+        return _NOOP
+    return span(name, **attrs_template)
 
 
 def record_span(
@@ -335,7 +371,7 @@ def record_span(
     """After-the-fact span for durations measured elsewhere (e.g. the
     batcher knows a request's queue wait only at flush time). Recorded
     only when ``ctx`` (default: current) is sampled."""
-    ctx = ctx if ctx is not None else _trace.get()
+    ctx = ctx if ctx is not None else _state.get()[0]
     if ctx is None or not ctx.sampled:
         return None
     record = {

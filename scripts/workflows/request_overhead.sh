@@ -1,0 +1,97 @@
+#!/usr/bin/env bash
+# Small-request hot-path gate: the fast1/BEFS codec suites, a
+# request_overhead bench run with its throughput-regression check
+# against the committed decomposition artifact, and the analyzer's
+# hot-path diff check so a PR that adds a new per-request env read (or
+# any BE-PERF-3xx cost) to the request path fails before it ships.
+#
+# Regression gate: absolute req/s across heterogeneous CI hosts is
+# weather, so the gate reads the DIMENSIONLESS paired speedup the
+# stage computes (fast leg vs same-interpreter pre-fast1 baseline,
+# median of per-round paired ratios). A hot-path regression makes the
+# fast leg slower relative to its own baseline on ANY machine; the
+# gate fails when that normalized throughput drops >10% below the
+# committed request-overhead.json.
+#
+# Knobs:
+#   REQ_GATE_MIN_SPEEDUP  override the computed floor (escape hatch
+#                         for a known-noisy runner)
+set -euo pipefail
+
+cd "$(dirname "$0")/../.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== fast-frame codec + rpc test suites =="
+timeout -k 10 600 python -m pytest \
+    tests/test_rpc_fast_frames.py tests/test_rpc.py -q -rA \
+    -p no:cacheprovider
+
+echo "== request_overhead bench =="
+out="$(mktemp)"
+timeout -k 10 300 env BENCH_PLATFORM=cpu BENCH_DEADLINE=240 \
+    BENCH_CONFIGS=request_overhead python bench.py | tail -n1 > "$out"
+REQ_GATE_MIN_SPEEDUP="${REQ_GATE_MIN_SPEEDUP:-}" python - "$out" <<'EOF'
+import json
+import os
+import sys
+
+with open(sys.argv[1]) as f:
+    d = json.loads(f.read())
+st = d["extra"]["request_overhead"]
+assert st and st.get("ok"), st
+
+# wiring, not weather: the fast legs must actually have run on BEFS
+assert st["legs"]["baseline"]["fast_frames"] is False
+assert st["legs"]["baseline"]["small_frames_out"] == 0
+for leg in ("fast_tcp", "fast"):
+    assert st["legs"][leg]["fast_frames"] is True, leg
+    assert st["legs"][leg]["fast_frame_hit_rate"] == 1.0, leg
+
+committed = json.load(open("request-overhead.json"))
+floor = os.environ.get("REQ_GATE_MIN_SPEEDUP")
+floor = (
+    float(floor) if floor else 0.9 * committed["uncontended_speedup"]
+)
+live = st["uncontended_speedup"]
+assert live >= floor, (
+    f"uncontended small-request speedup regressed: live {live}x < "
+    f"floor {floor:.2f}x (committed {committed['uncontended_speedup']}x "
+    "- 10%); the fast path got slower relative to its own baseline"
+)
+print(
+    f"request_overhead OK: uncontended {live}x (floor {floor:.2f}x), "
+    f"concurrent {st['concurrent_speedup']}x, "
+    f"fast p50 {st['legs']['fast']['uncontended']['p50_us']}us vs "
+    f"baseline {st['legs']['baseline']['uncontended']['p50_us']}us"
+)
+EOF
+
+echo "== hot-path report diff check =="
+fresh="$(mktemp)"
+hp_rc=0
+python -m bioengine_tpu.analysis bioengine_tpu/ apps/ \
+    --hot-path-report "$fresh" >/dev/null || hp_rc=$?
+if [[ "$hp_rc" -ge 2 ]]; then
+    echo "request_overhead: analyzer error (rc=$hp_rc)" >&2
+    exit "$hp_rc"
+fi
+python - "$fresh" <<'EOF'
+import json
+import sys
+
+fresh = json.load(open(sys.argv[1]))
+committed = json.load(open("hot-path-report.json"))
+assert fresh["schema"] == committed["schema"], fresh.get("schema")
+new = fresh["totals"]["findings"]
+old = committed["totals"]["findings"]
+assert new <= old, (
+    f"hot-path findings grew {old} -> {new}: this change adds "
+    "per-request overhead (new BE-PERF-3xx finding on a request-path "
+    "root). Fix it or regenerate hot-path-report.json with an inline "
+    "justification."
+)
+print(f"hot-path diff OK: {new} finding(s) (committed {old})")
+EOF
+
+echo "request_overhead gate OK"

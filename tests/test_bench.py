@@ -306,6 +306,74 @@ def test_observability_overhead_stage_schema():
     )
 
 
+def test_request_overhead_stage_schema():
+    """Pin the request_overhead artifact schema: three interleaved legs
+    (baseline = pre-fast1 stack on TCP, fast_tcp = BEFS + inline
+    dispatch on the identical wire, fast = same over the unix socket),
+    per-leg uncontended/concurrent throughput, the live-stats codec
+    bucket, the per-request decomposition, and the paired speedups.
+    The >=2x uncontended acceptance number comes from the full-size
+    driver run — a loaded CI core would flake a hard threshold here,
+    so the schema and fast-frame wiring are the contract."""
+    proc, lines = _run(
+        {
+            "BENCH_CONFIGS": "request_overhead",
+            "BENCH_DEADLINE": "170",
+            "BENCH_REQ_ROUNDS": "3",
+            "BENCH_REQ_N": "40",
+            "BENCH_REQ_CALLERS": "4",
+            "BENCH_REQ_PER_CALLER": "5",
+        },
+        timeout=200.0,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    st = json.loads(lines[-1])["extra"]["request_overhead"]
+    assert st["ok"], st
+    for key in (
+        "legs",
+        "decomposition_us",
+        "uncontended_speedup",
+        "concurrent_speedup",
+        "threshold_bytes",
+    ):
+        assert key in st, key
+    for leg in ("baseline", "fast_tcp", "fast"):
+        lg = st["legs"][leg]
+        for key in (
+            "transport",
+            "uncontended",
+            "concurrent",
+            "codec_us_per_req",
+            "fast_frames",
+            "small_frames_out",
+            "fast_frame_hit_rate",
+        ):
+            assert key in lg, (leg, key)
+        for key in ("req_per_sec", "p50_us", "p95_us", "median_req_per_sec"):
+            assert lg["uncontended"][key] > 0, (leg, key)
+        assert lg["concurrent"]["req_per_sec"] > 0, leg
+    for key in (
+        "codec_us",
+        "tracing_ctx_us",
+        "scheduler_us",
+        "scoring_us",
+        "asyncio_hop_us",
+        "wire_residual_us",
+    ):
+        assert key in st["decomposition_us"], key
+    # the fast-frame wiring is the contract: the baseline leg must
+    # have negotiated NO fast frames and the fast legs must have run
+    # entirely on them
+    assert st["legs"]["baseline"]["fast_frames"] is False
+    assert st["legs"]["baseline"]["small_frames_out"] == 0
+    for leg in ("fast_tcp", "fast"):
+        assert st["legs"][leg]["fast_frames"] is True
+        assert st["legs"][leg]["small_frames_out"] > 0, leg
+        assert st["legs"][leg]["fast_frame_hit_rate"] == 1.0, leg
+    assert st["legs"]["fast"]["transport"] == "uds"
+    assert st["legs"]["fast_tcp"]["transport"] == "tcp"
+
+
 def test_scheduler_goodput_stage_schema():
     """Pin the scheduler_goodput artifact schema: per-request router vs
     global scheduler on the same mixed-priority workload (goodput, per
