@@ -64,7 +64,9 @@ Env overrides:
                         multihost_mesh,cold_start,cellpose,search,
                         observability_overhead,scheduler_goodput,flash,
                         unet3d,ivfpq,pqflat,rpc_transport,
-                        request_overhead
+                        request_overhead,router_scaling
+  BENCH_ROUTER_LEGS=a,b router counts for the router_scaling stage
+                        (default 1,2,4,8)
   BENCH_PROBE_CADENCE=N seconds between tunnel probes while wedged
                         (default 60)
   BENCH_REPS=N          timed reps per stage (default 2, best-of)
@@ -104,6 +106,7 @@ STAGE_COSTS = {
     "pqflat": 80,
     "rpc_transport": 60,
     "request_overhead": 30,
+    "router_scaling": 30,
 }
 DEFAULT_CONFIGS = tuple(STAGE_COSTS)
 
@@ -2404,6 +2407,180 @@ def _bench_gray_failure(cpu: bool) -> dict:  # noqa: ARG001 — pure host path
     return out
 
 
+def _bench_router_scaling(cpu: bool) -> dict:  # noqa: ARG001 — pure host path
+    """Goodput-vs-router-count on the scale-out router tier.
+
+    Runs the ``fleet_scale`` scenario (hundreds of simulated mesh hosts
+    in the published routing table, a large local replica pool, offered
+    load far beyond one router's admission capacity) once per router
+    count in BENCH_ROUTER_LEGS (default 1,2,4,8). Each router holds a
+    locally cached epoch-stamped routing table and admits up to its
+    inflight cap, so served goodput is capacity-bound PER ROUTER and
+    must scale near-linearly with router count until the offered load
+    is fully served — ``goodput_scaling_4x_vs_1 >= 3.0`` is the
+    acceptance gate. ``router_loss`` rides along as the availability
+    leg: one of three routers SIGKILL'd mid-traffic must lose zero
+    idempotent requests (clients hop to a sibling on the typed
+    RouterClosedError). ``per_request_overhead_us`` pins what a request
+    pays for the router seam itself: serial p50/p99 through an
+    in-process controller handle vs a table-synced StandaloneRouter
+    handle over the same replica pool (the request_overhead stage's
+    perf_counter_ns methodology).
+
+    Env: BENCH_ROUTER_LEGS / BENCH_ROUTER_SEED / BENCH_ROUTER_PROBE_N.
+    """
+    import asyncio
+    import dataclasses
+
+    from bioengine_tpu.testing.scenarios import (
+        FLEET_SCALE,
+        ROUTER_LOSS,
+        run_scenario_async,
+    )
+
+    seed = int(os.environ.get("BENCH_ROUTER_SEED", "7"))
+    legs_spec = os.environ.get("BENCH_ROUTER_LEGS", "1,2,4,8")
+    router_counts = [
+        int(tok) for tok in legs_spec.split(",") if tok.strip()
+    ]
+    probe_n = int(os.environ.get("BENCH_ROUTER_PROBE_N", "300"))
+
+    async def scaling_legs() -> dict:
+        legs: dict[str, dict] = {}
+        for n in router_counts:
+            scenario = dataclasses.replace(FLEET_SCALE, n_routers=n)
+            r = await run_scenario_async(scenario, seed=seed)
+            served = r["routers"]["raw_ok"]
+            legs[str(n)] = {
+                "routers": n,
+                "offered": r["requests"],
+                "served": served,
+                "wall_s": r["wall_s"],
+                "goodput_rps": round(served / max(r["wall_s"], 1e-9), 1),
+                "table_staleness_max_s": r["routers"]["staleness_max_s"],
+                "invariants_ok": r["passed"],
+            }
+        return legs
+
+    async def loss_leg() -> dict:
+        r = await run_scenario_async(ROUTER_LOSS, seed=seed)
+        failed = sum(
+            n for out, n in r["counts"].items() if out != "ok"
+        )
+        return {
+            "requests": r["requests"],
+            "failed_idempotent": failed,
+            "client_failovers": r["routers"]["client_failovers"],
+            "killed": r["routers"]["killed"],
+            "table_staleness_max_s": r["routers"]["staleness_max_s"],
+            "invariants_ok": r["passed"],
+        }
+
+    async def overhead_probe() -> dict:
+        from bioengine_tpu.cluster.state import ClusterState
+        from bioengine_tpu.serving import (
+            DeploymentSpec,
+            ServeController,
+            StandaloneRouter,
+            shared_object_resolver,
+        )
+
+        class _Echo:
+            async def work(self, a: int = 0, b: int = 0):
+                return {"sum": a + b}
+
+        controller = ServeController(
+            ClusterState(), health_check_period=3600
+        )
+        await controller.deploy(
+            "probe-app",
+            [
+                DeploymentSpec(
+                    name="dep",
+                    instance_factory=_Echo,
+                    num_replicas=4,
+                    min_replicas=4,
+                    max_replicas=4,
+                    autoscale=False,
+                )
+            ],
+        )
+        router = StandaloneRouter(
+            "probe", shared_object_resolver(controller)
+        )
+        router.sync_from(controller)
+
+        async def leg(core) -> dict:
+            handle = core.get_handle("probe-app", "dep")
+            for _ in range(50):
+                await handle.call("work", 1, 2)
+            lat_us: list = []
+            for _ in range(probe_n):
+                t0 = time.perf_counter_ns()
+                await handle.call("work", 1, 2)
+                lat_us.append((time.perf_counter_ns() - t0) / 1e3)
+            lat_us.sort()
+            return {
+                "p50_us": round(lat_us[len(lat_us) // 2], 1),
+                "p99_us": round(lat_us[int(len(lat_us) * 0.99)], 1),
+            }
+
+        try:
+            via_controller = await leg(controller)
+            via_router = await leg(router)
+        finally:
+            router.kill()
+            await controller.stop()
+        return {
+            "controller": via_controller,
+            "router": via_router,
+            "router_delta_us_p50": round(
+                via_router["p50_us"] - via_controller["p50_us"], 1
+            ),
+        }
+
+    async def run():
+        return (
+            await scaling_legs(),
+            await loss_leg(),
+            await overhead_probe(),
+        )
+
+    legs, loss, probe = asyncio.run(run())
+
+    scaling = None
+    if "1" in legs and "4" in legs:
+        scaling = round(
+            legs["4"]["goodput_rps"]
+            / max(legs["1"]["goodput_rps"], 1e-9),
+            2,
+        )
+    out = {
+        "scenario": FLEET_SCALE.name,
+        "seed": seed,
+        "legs": legs,
+        "goodput_scaling_4x_vs_1": scaling,
+        "router_loss": loss,
+        "per_request_overhead_us": probe,
+        "ok": (
+            all(leg["invariants_ok"] for leg in legs.values())
+            and loss["invariants_ok"]
+            and loss["failed_idempotent"] == 0
+            and (scaling is None or scaling >= 3.0)
+        ),
+        "note": (
+            "goodput is ADMISSION-capacity-bound per router (inflight "
+            "cap x service time), which is what scales out when each "
+            "router is its own process; all legs here share one "
+            "interpreter, so per-request CPU does not scale and the "
+            "absolute goodput numbers are not a throughput claim. "
+            "router_loss is the availability leg: a SIGKILL'd router "
+            "mid-traffic, zero idempotent loss via sibling failover."
+        ),
+    }
+    return out
+
+
 def worker_main() -> int:
     cpu = os.environ.get("BENCH_PLATFORM", "").lower() == "cpu"
     if cpu:
@@ -2474,6 +2651,7 @@ def worker_main() -> int:
         "pqflat": _bench_pqflat,
         "rpc_transport": _bench_rpc_transport,
         "request_overhead": _bench_request_overhead,
+        "router_scaling": _bench_router_scaling,
     }
     if os.environ.get("BENCH_SLEEP_S"):
         # test-only stage (tests/test_bench.py): a deterministic
@@ -2789,6 +2967,7 @@ def _final_json(shared: _Shared, deadline_hit: bool) -> str:
             "flash_attention": shared.stages.get("flash"),
             "rpc_transport": shared.stages.get("rpc_transport"),
             "request_overhead": shared.stages.get("request_overhead"),
+            "router_scaling": shared.stages.get("router_scaling"),
             "observability_overhead": shared.stages.get(
                 "observability_overhead"
             ),

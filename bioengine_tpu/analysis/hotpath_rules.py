@@ -118,7 +118,8 @@ _KIND_TO_RULE = {
 # `# analyze: hot-path-root` marker, not here, unless the root is a
 # permanent architectural entry point.
 HOT_PATH_ROOT_CATALOG: tuple[tuple[str, str], ...] = (
-    ("bioengine_tpu.serving.controller", "DeploymentHandle.call"),
+    ("bioengine_tpu.serving.router", "DeploymentHandle.call"),
+    ("bioengine_tpu.serving.router", "StandaloneRouter.apply_table"),
     ("bioengine_tpu.serving.scheduler", "DeploymentScheduler.submit"),
     ("bioengine_tpu.serving.scheduler", "DeploymentScheduler._dispatch_group"),
     ("bioengine_tpu.serving.replica", "Replica.call"),

@@ -12,7 +12,17 @@ from bioengine_tpu.serving.errors import (
     NoHealthyReplicasError,
     ReplicaUnavailableError,
     RetryableTransportError,
+    RouterClosedError,
+    RouterSaturatedError,
     StaleEpochError,
+    StaleTableError,
+)
+from bioengine_tpu.serving.router import (
+    RouterCore,
+    RoutingTablePublisher,
+    StandaloneRouter,
+    remote_replica_resolver,
+    shared_object_resolver,
 )
 from bioengine_tpu.serving.journal import ControlJournal, JournalState
 from bioengine_tpu.serving.mesh_plan import (
@@ -63,11 +73,19 @@ __all__ = [
     "ReplicaUnavailableError",
     "RequestOptions",
     "RetryableTransportError",
+    "RouterClosedError",
+    "RouterCore",
+    "RouterSaturatedError",
+    "RoutingTablePublisher",
     "SchedulingConfig",
     "SLOConfig",
     "StaleEpochError",
+    "StaleTableError",
     "SLOEngine",
     "ServeController",
+    "StandaloneRouter",
+    "remote_replica_resolver",
+    "shared_object_resolver",
     "CompileCacheTier",
     "WarmPool",
     "WarmPoolConfig",

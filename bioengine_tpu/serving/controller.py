@@ -18,42 +18,24 @@ bioengine/apps/proxy_deployment.py:25-47, bioengine/apps/manager.py:
 from __future__ import annotations
 
 import asyncio
-import itertools
 import os
 import time
-from collections import defaultdict, deque
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from bioengine_tpu.cluster.state import ClusterState
-from bioengine_tpu.rpc.protocol import PROTO_EPOCH1, PROTO_MESH1, RemoteError
-from bioengine_tpu.serving.errors import (
-    AdmissionRejectedError,
-    DeadlineExceeded,
-    FailureKind,
-    NoHealthyReplicasError,
-    ReplicaUnavailableError,
-    RetryableTransportError,
-    classify_exception,
-    is_caller_timeout,
-    is_retryable,
-)
+from bioengine_tpu.rpc.protocol import PROTO_EPOCH1, PROTO_MESH1
 from bioengine_tpu.serving.mesh_plan import (
     MeshConfig,
     MeshPlanError,
     plan_mesh,
 )
-from bioengine_tpu.serving.outlier import (
-    DeploymentLatencyTracker,
-    OutlierConfig,
-    REPLICA_PROBATIONS,
-    record_probation_event,
-)
+from bioengine_tpu.serving.outlier import OutlierConfig
 from bioengine_tpu.serving.mesh_replica import MeshReplica
 from bioengine_tpu.serving.remote import RemoteReplica
 from bioengine_tpu.serving.scheduler import (
     DeploymentScheduler,
-    HeuristicCostModel,
     SchedulingConfig,
 )
 from bioengine_tpu.serving.replica import (
@@ -61,6 +43,19 @@ from bioengine_tpu.serving.replica import (
     ROUTABLE_STATES,
     Replica,
     ReplicaState,
+)
+from bioengine_tpu.serving.router import (
+    BREAKER_TRIPS,
+    REQUEST_E2E,
+    REQUEST_FAILOVERS,
+    REQUEST_HEDGES,
+    REQUEST_OUTCOMES,
+    ROUTE_WAIT,
+    DeploymentHandle,
+    RequestOptions,
+    RouterCore,
+    RoutingTablePublisher,
+    _min_defined,
 )
 from bioengine_tpu.serving.slo import SLOConfig, SLOEngine
 from bioengine_tpu.serving.compile_tier import CompileCacheTier
@@ -77,42 +72,13 @@ from bioengine_tpu.utils.telemetry import (
     RegistrySampler,
     TelemetryStore,
 )
-from bioengine_tpu.utils.backoff import full_jitter_delay
 from bioengine_tpu.utils.logger import create_logger
 
-# ---- request-path metrics (process-wide, utils/metrics.py) ---------------
-# e2e latency is what the SLO dashboard reads; outcome/failover counters
-# are what the future global scheduler keys on (ROADMAP item 1)
-REQUEST_E2E = metrics.histogram(
-    "request_e2e_seconds",
-    "end-to-end DeploymentHandle.call latency (route + retries + execute)",
-    ("app", "deployment", "method"),
-)
-REQUEST_OUTCOMES = metrics.counter(
-    "requests_total",
-    "completed DeploymentHandle.call requests by outcome",
-    ("app", "deployment", "outcome"),
-)
-REQUEST_FAILOVERS = metrics.counter(
-    "request_failovers_total",
-    "attempts retried on another replica after a transport failure",
-    ("app", "deployment"),
-)
-ROUTE_WAIT = metrics.histogram(
-    "route_wait_seconds",
-    "time spent picking (or waiting for) a routable replica",
-    ("app", "deployment"),
-)
-BREAKER_TRIPS = metrics.counter(
-    "breaker_trips_total",
-    "circuit-breaker ejections (replica marked UNHEALTHY)",
-    ("app", "deployment"),
-)
-REQUEST_HEDGES = metrics.counter(
-    "request_hedges_total",
-    "hedge attempts launched for idempotent calls, by winning attempt",
-    ("app", "deployment", "winner"),
-)
+# The request-path metric families (REQUEST_E2E, REQUEST_OUTCOMES,
+# REQUEST_FAILOVERS, ROUTE_WAIT, BREAKER_TRIPS, REQUEST_HEDGES) moved to
+# serving/router.py with the request path itself; they are re-imported
+# above so existing `controller.REQUEST_*` references keep resolving.
+
 # durable control plane (serving/journal.py): the fencing epoch this
 # process serves under, and what the recovery reconcile did
 CONTROLLER_EPOCH = metrics.gauge(
@@ -203,79 +169,6 @@ def _collect_controllers(instances: list) -> list:
 _CONTROLLERS = metrics.InstanceSet("serve_controller", _collect_controllers)
 
 
-@dataclass(frozen=True)
-class RequestOptions:
-    """Per-request envelope for ``DeploymentHandle.call``.
-
-    ``deadline_s`` bounds the WHOLE request (every attempt + backoff);
-    ``timeout_s`` bounds one attempt and is propagated to the serving
-    host so remote work is aborted there too. ``idempotent`` opts the
-    call into transparent failover: transport/placement errors retry
-    on another healthy replica with exponential backoff + full jitter.
-    Non-idempotent calls surface the first transport error exactly
-    once, typed (``RetryableTransportError``) — never silently retried,
-    because the outcome on the dead replica is ambiguous.
-
-    ``priority`` and ``tenant`` only matter on deployments with a
-    global scheduler attached: the priority class picks the
-    weighted-fair queue (``interactive`` / ``bulk`` / ``background`` by
-    default) and the tenant id counts against the per-tenant admission
-    quota.
-
-    ``hedge`` opts an **idempotent** call into request hedging (the
-    gray-failure tail defense): when the first attempt is still
-    running after a p95-derived delay (override: ``hedge_delay_s``), a
-    second attempt launches on a DIFFERENT replica; the first result
-    wins and the loser is cancelled — never counted against the
-    breaker or the latency outlier detector (a loser cancelled by the
-    winner is not replica-failure evidence). Hedging a non-idempotent
-    call would double side effects, so that combination is rejected at
-    construction — hedges can never fire for non-idempotent calls.
-    Hedging applies to ROUTER-path deployments only: on a deployment
-    with a ``scheduling:`` config the global scheduler owns placement
-    (probation rides its scorer feature dict instead) and ``hedge`` is
-    ignored."""
-
-    timeout_s: Optional[float] = None
-    deadline_s: Optional[float] = None
-    idempotent: bool = False
-    max_attempts: int = 4
-    backoff_base_s: float = 0.05
-    backoff_cap_s: float = 2.0
-    priority: Optional[str] = None     # scheduler class; None = default
-    tenant: Optional[str] = None       # admission quota bucket
-    hedge: bool = False                # idempotent-only tail hedging
-    hedge_delay_s: Optional[float] = None  # None = deployment p95
-
-    def __post_init__(self):
-        if self.hedge and not self.idempotent:
-            raise ValueError(
-                "RequestOptions(hedge=True) requires idempotent=True — "
-                "a hedge is a silent second execution, which a "
-                "non-idempotent call can never tolerate"
-            )
-
-    @classmethod
-    def from_env(cls) -> "RequestOptions":
-        env = os.environ.get
-        return cls(
-            max_attempts=int(env("BIOENGINE_REQUEST_MAX_ATTEMPTS", "4")),
-            backoff_base_s=float(env("BIOENGINE_REQUEST_BACKOFF_BASE_S", "0.05")),
-            backoff_cap_s=float(env("BIOENGINE_REQUEST_BACKOFF_CAP_S", "2.0")),
-        )
-
-    @classmethod
-    def defaults(cls) -> "RequestOptions":
-        """Env-derived defaults, read once (this sits on the hot path)."""
-        global _DEFAULT_OPTIONS
-        if _DEFAULT_OPTIONS is None:
-            _DEFAULT_OPTIONS = cls.from_env()
-        return _DEFAULT_OPTIONS
-
-
-_DEFAULT_OPTIONS: Optional[RequestOptions] = None
-
-
 @dataclass
 class DeploymentSpec:
     name: str
@@ -339,614 +232,7 @@ class AppDeployment:
     acl: Any = None
 
 
-class DeploymentHandle:
-    """Client-side handle: route calls to healthy replicas (least-loaded,
-    round-robin tie-break). The composition mechanism: entry deployments
-    receive handles to their sibling deployments as init kwargs, same as
-    the reference's DeploymentHandle binding (ref apps/builder.py:1474-1508).
-
-    Fault tolerance: each call runs under a :class:`RequestOptions`
-    envelope (pass ``options=RequestOptions(...)`` per call, or bind
-    defaults with :meth:`with_options`). Transport/placement failures on
-    idempotent calls fail over to another replica; during a restart
-    window the router WAITS (bounded by the deadline) for a healthy
-    replica instead of raising instantly."""
-
-    def __init__(
-        self,
-        controller: "ServeController",
-        app_id: str,
-        deployment: str,
-        options: Optional[RequestOptions] = None,
-    ):
-        self._controller = controller
-        self.app_id = app_id
-        self.deployment = deployment
-        self._options = options
-        self._rr = itertools.count()
-        # labeled children resolved once — labels() costs a few us of
-        # str()/tuple/lock per lookup, paid per request otherwise
-        self._m_route_wait = ROUTE_WAIT.labels(app_id, deployment)
-        self._m_failovers = REQUEST_FAILOVERS.labels(app_id, deployment)
-        self._m_e2e: dict[str, Any] = {}       # method -> histogram child
-        self._m_outcomes: dict[str, Any] = {}  # outcome -> counter child
-        self._m_hedges: dict[str, Any] = {}    # winner -> counter child
-        # prebuilt span-attr template: the route span's attrs never
-        # change for a handle, so the unsampled hot path must not
-        # allocate a kwargs dict per request just to throw it away
-        self._ts_route = {"app": app_id, "deployment": deployment}
-
-    def with_options(self, options: RequestOptions) -> "DeploymentHandle":
-        """A sibling handle whose calls default to ``options``."""
-        return DeploymentHandle(
-            self._controller, self.app_id, self.deployment, options
-        )
-
-    async def call(self, method: str, *args, **kwargs) -> Any:
-        # the envelope rides a reserved kwarg, but ONLY when it is an
-        # actual RequestOptions — an app method's own `options` kwarg
-        # passes through untouched
-        options = kwargs.pop("options", None)
-        if options is not None and not isinstance(options, RequestOptions):
-            kwargs["options"] = options
-            options = None
-        options = options or self._options or RequestOptions.defaults()
-
-        # Observability wrapper. A trace context is minted here (the
-        # client edge of the serve path) and rides the contextvar
-        # through routing, the RPC envelope (capability-negotiated),
-        # the host's replica, batcher, and engine — get_traces
-        # reassembles one cross-process tree per trace_id. Head
-        # sampling (BIOENGINE_TRACE_SAMPLE) keeps the unsampled path
-        # at one id mint + a few counter bumps; BIOENGINE_TRACING=0
-        # removes even that (the bench's baseline leg) — but metrics
-        # and slow-request logging have their OWN knobs and keep
-        # working with tracing off. If a sampled trace is ALREADY
-        # active (a composition call routed back through serve-router),
-        # nest under it instead of minting.
-        parent = tracing.current_trace()
-        ctx = parent if parent is not None else tracing.maybe_start_trace()
-        token = (
-            tracing.activate(ctx)
-            if ctx is not None and parent is None
-            else None
-        )
-        t0 = time.monotonic()
-        outcome = "ok"
-        try:
-            if ctx is not None and ctx.sampled:
-                with tracing.span(
-                    "request",
-                    app=self.app_id,
-                    deployment=self.deployment,
-                    method=method,
-                    trace_root=parent is None,
-                ) as record:
-                    result = await self._call_attempts(
-                        method, args, kwargs, options
-                    )
-                    # per-request device cost on the TRACE ROOT: the sum
-                    # of every engine.predict under this trace_id (local
-                    # spans plus the ones absorbed off RESULT frames),
-                    # each already engine wall-seconds x mesh width.
-                    # Nested composition spans don't stamp — the whole
-                    # trace's cost belongs to exactly one root.
-                    if parent is None:
-                        cs = tracing.trace_attr_sum(
-                            ctx.trace_id, "engine.predict", "chip_seconds"
-                        )
-                        if cs:
-                            record["attrs"]["chip_seconds"] = round(cs, 6)
-                    return result
-            return await self._call_attempts(method, args, kwargs, options)
-        except Exception as e:
-            kind = classify_exception(e)
-            outcome = {
-                FailureKind.APPLICATION: "app_error",
-                FailureKind.DEADLINE: "deadline",
-            }.get(kind, "transport_error")
-            if isinstance(e, AdmissionRejectedError):
-                # load shedding is its own outcome: an SLO dashboard
-                # must tell "we said no" apart from "the app broke"
-                outcome = "rejected"
-            if kind is FailureKind.DEADLINE:
-                # the evidence of WHY the budget was blown (breaker
-                # trips, re-placements, parks) is in the ring right now
-                # — snapshot it before it wraps
-                flight.record(
-                    "deadline.exceeded",
-                    severity="error",
-                    app=self.app_id,
-                    deployment=self.deployment,
-                    method=method,
-                    trace_id=ctx.trace_id if ctx else None,
-                    error=str(e)[:500],
-                )
-                flight.dump(
-                    "deadline_exceeded",
-                    app=self.app_id,
-                    deployment=self.deployment,
-                )
-            raise
-        finally:
-            duration = time.monotonic() - t0
-            if token is not None:
-                tracing.deactivate(token)
-            if metrics.metrics_enabled():
-                e2e = self._m_e2e.get(method)
-                if e2e is None:
-                    e2e = self._m_e2e[method] = REQUEST_E2E.labels(
-                        self.app_id, self.deployment, method
-                    )
-                e2e.observe(duration)
-                out_c = self._m_outcomes.get(outcome)
-                if out_c is None:
-                    out_c = self._m_outcomes[outcome] = REQUEST_OUTCOMES.labels(
-                        self.app_id, self.deployment, outcome
-                    )
-                out_c.inc()
-            slow_ms = tracing.slow_request_threshold_ms()
-            if slow_ms > 0 and duration * 1000.0 >= slow_ms:
-                # structured + trace_id-stamped: grep the log line,
-                # then get_traces(trace_id=...) for the breakdown
-                # (trace_id=- when tracing is globally disabled)
-                self._controller.logger.warning(
-                    "slow_request "
-                    f"trace_id={ctx.trace_id if ctx else '-'} "
-                    f"app={self.app_id} "
-                    f"deployment={self.deployment} method={method} "
-                    f"duration_ms={duration * 1000.0:.1f} "
-                    f"outcome={outcome} "
-                    f"sampled={ctx.sampled if ctx else False}"
-                )
-                flight.record(
-                    "request.slow",
-                    severity="warning",
-                    app=self.app_id,
-                    deployment=self.deployment,
-                    method=method,
-                    duration_ms=round(duration * 1000.0, 1),
-                    outcome=outcome,
-                    trace_id=ctx.trace_id if ctx else None,
-                )
-
-    async def _call_attempts(
-        self, method: str, args: tuple, kwargs: dict, options: RequestOptions
-    ) -> Any:
-        deadline = (
-            time.monotonic() + options.deadline_s
-            if options.deadline_s is not None
-            else None
-        )
-        key = (self.app_id, self.deployment)
-        tried: set[str] = set()
-        attempt = 0
-        while True:
-            attempt += 1
-            remaining = None if deadline is None else deadline - time.monotonic()
-            if remaining is not None and remaining <= 0:
-                raise DeadlineExceeded(
-                    f"deadline exhausted after {attempt - 1} attempt(s) "
-                    f"for {self.app_id}/{self.deployment}.{method}"
-                )
-            scheduler = self._controller._schedulers.get(key)
-            replica = None
-            if scheduler is None:
-                t_route = time.monotonic()
-                with tracing.trace_span_t("route", self._ts_route):
-                    replica = await self._controller._pick_replica_wait(
-                        self.app_id, self.deployment, avoid=tried,
-                        deadline=deadline,
-                    )
-                if metrics.metrics_enabled():
-                    self._m_route_wait.observe(time.monotonic() - t_route)
-                # the wait above may have parked through most of the
-                # budget — recompute so the attempt (and the host-side
-                # timeout it propagates) cannot overrun the deadline
-                if deadline is not None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        raise DeadlineExceeded(
-                            f"deadline exhausted while waiting for a replica "
-                            f"of {self.app_id}/{self.deployment}"
-                        )
-            budget = _min_defined(options.timeout_s, remaining)
-            self._controller._queue_depth[key] += 1
-            # hedged attempts do their own breaker/latency bookkeeping
-            # per sub-attempt (a cancelled loser must feed NEITHER) —
-            # the outer handlers skip theirs to avoid double counting
-            hedged = (
-                scheduler is None
-                and replica is not None
-                and options.hedge
-                and options.idempotent
-            )
-            try:
-                if hedged:
-                    result = await self._hedged_attempt(
-                        replica, method, args, kwargs, options,
-                        budget, deadline, tried, attempt,
-                    )
-                    return result
-                # attempt attrs vary per call — gate the kwargs-dict
-                # build on the sampled check instead of templating
-                with (
-                    tracing.span(
-                        "attempt",
-                        replica=replica.replica_id
-                        if replica
-                        else "scheduler",
-                        attempt=attempt,
-                    )
-                    if tracing.sampled()
-                    else tracing.NOOP_SPAN
-                ):
-                    if scheduler is None:
-                        t_attempt = time.monotonic()
-                        result = await replica.call_bounded(
-                            method, args, kwargs, timeout_s=budget
-                        )
-                        # successful-attempt service time feeds the
-                        # gray-failure outlier EWMA (failures measure
-                        # the transport, not the replica)
-                        self._controller._note_attempt_latency(
-                            replica, time.monotonic() - t_attempt
-                        )
-                    else:
-                        # the scheduler owns admission, fair queueing,
-                        # group coalescing, and the scored replica pick
-                        # for this attempt; breaker bookkeeping happens
-                        # inside its dispatch (it saw the replica, we
-                        # did not)
-                        result = await scheduler.submit(
-                            method,
-                            args,
-                            kwargs,
-                            options=options,
-                            timeout_s=budget,
-                            deadline=deadline,
-                            avoid=frozenset(tried),
-                        )
-                if replica is not None:
-                    self._controller._breaker_success(replica)
-                return result
-            except Exception as e:
-                kind = classify_exception(e)
-                if kind is FailureKind.APPLICATION:
-                    raise  # the app ran and failed — never retried
-                # a timeout of the CALLER's own budget says nothing
-                # about replica health — only genuine transport/placement
-                # failures feed the circuit breaker
-                if (
-                    replica is not None
-                    and not hedged
-                    and not is_caller_timeout(e)
-                ):
-                    self._controller._breaker_failure(replica, e)
-                # scheduler-dispatched failures stamp the serving
-                # replica on the exception so failover can avoid it
-                rid = (
-                    replica.replica_id
-                    if replica is not None
-                    else getattr(e, "replica_id", None)
-                )
-                if rid is not None:
-                    tried.add(rid)
-                if isinstance(e, DeadlineExceeded):
-                    raise
-                remaining = (
-                    None if deadline is None else deadline - time.monotonic()
-                )
-                if remaining is not None and remaining <= 0:
-                    # the overall budget is gone — surface it AS a
-                    # deadline on every path (a non-idempotent attempt
-                    # whose timeout WAS the deadline cut included)
-                    raise DeadlineExceeded(
-                        f"deadline exhausted after {attempt} attempt(s): {e}"
-                    ) from e
-                # a LOCAL ReplicaUnavailableError was raised by the
-                # routability check BEFORE anything was sent — zero
-                # ambiguity, so even non-idempotent calls fail over
-                not_executed = isinstance(
-                    e, ReplicaUnavailableError
-                ) and not isinstance(e, RemoteError)
-                if not options.idempotent and not not_executed:
-                    raise RetryableTransportError(
-                        f"{self.app_id}/{self.deployment}.{method} failed in "
-                        f"transport on {rid or 'scheduler'} (non-idempotent "
-                        f"call, not retried): {e}"
-                    ) from e
-                if attempt >= options.max_attempts:
-                    raise RetryableTransportError(
-                        f"{self.app_id}/{self.deployment}.{method} failed "
-                        f"after {attempt} attempts: {e}"
-                    ) from e
-                if metrics.metrics_enabled():
-                    self._m_failovers.inc()
-                flight.record(
-                    "request.failover",
-                    severity="warning",
-                    app=self.app_id,
-                    deployment=self.deployment,
-                    method=method,
-                    replica=rid,
-                    attempt=attempt,
-                    error=str(e)[:300],
-                )
-                # exponential backoff with FULL jitter, clamped to the
-                # remaining deadline budget
-                delay = full_jitter_delay(
-                    attempt - 1, options.backoff_base_s, options.backoff_cap_s
-                )
-                if remaining is not None:
-                    delay = min(delay, max(0.0, remaining))
-                await asyncio.sleep(delay)
-            finally:
-                # router-state leak discipline: undeploy sweeps this
-                # entry, but an in-flight retry's increment (defaultdict)
-                # can resurrect it — so the decrement clamps at zero
-                # (never a persistent negative, even when old-generation
-                # decrements interleave with a redeploy) and a key whose
-                # app is gone is swept here instead of lingering
-                depth = self._controller._queue_depth
-                if key in depth:
-                    if depth[key] > 0:
-                        depth[key] -= 1
-                    if (
-                        depth[key] <= 0
-                        and self.app_id not in self._controller.apps
-                    ):
-                        depth.pop(key, None)
-
-    # ---- request hedging (gray-failure tail defense) ------------------------
-
-    async def _hedged_attempt(
-        self,
-        primary,
-        method: str,
-        args: tuple,
-        kwargs: dict,
-        options: RequestOptions,
-        budget: Optional[float],
-        deadline: Optional[float],
-        tried: set,
-        attempt: int,
-    ) -> Any:
-        """One attempt with tail hedging: run on ``primary``; if it is
-        still in flight after the p95-derived delay, launch the SAME
-        call on a different replica — first result wins, the loser is
-        cancelled. Only reachable for idempotent calls (RequestOptions
-        enforces that at construction; the router re-checks).
-
-        Bookkeeping discipline — the satellite bug this pins: the
-        cancelled loser feeds NEITHER the circuit breaker NOR the
-        outlier EWMA (a loser cancelled by the winner is not replica-
-        failure evidence, the same class of bug as the caller-budget
-        breaker exemption). Only genuinely-failed sub-attempts strike
-        the breaker; only the winner's wall time feeds the EWMA. Both
-        sub-attempts open sibling ``attempt`` spans under the one
-        trace_id, so `get_traces` shows the hedge as two children of
-        the same request."""
-        controller = self._controller
-
-        async def run(target, label: str, timeout_s: Optional[float]):
-            t0 = time.monotonic()
-            # span opened INSIDE the task: each sub-attempt becomes its
-            # own sibling under the request/route span (create_task
-            # copies the context, so both inherit the same parent)
-            with tracing.trace_span(
-                "attempt",
-                replica=target.replica_id,
-                attempt=attempt,
-                hedge=label,
-            ):
-                result = await target.call_bounded(
-                    method, args, kwargs, timeout_s=timeout_s
-                )
-            return result, time.monotonic() - t0
-
-        # a probe-routed request (primary in PROBATION) is the trickle
-        # the recovery loop lives on: it hedges AT ONCE (delay 0 — the
-        # probe exists to measure the replica, not to make one unlucky
-        # caller pay the gray-latency tax), and on any exit the probe
-        # attempt is DETACHED to finish in the background instead of
-        # cancelled — cancelling it would throw away the one latency
-        # measurement the probe exists to take, freezing the replica
-        # in probation forever once every caller hedges. Bounded by
-        # the attempt's own timeout budget; chip/semaphore accounting
-        # settles on its normal completion path.
-        probing = primary.state == ReplicaState.PROBATION
-        t_primary = asyncio.create_task(run(primary, "primary", budget))
-        t_hedge: Optional[asyncio.Task] = None
-        detached: set = set()
-
-        async def resolve_primary_only() -> Any:
-            try:
-                result, dt = await t_primary
-            except asyncio.CancelledError:
-                raise
-            except Exception as exc:
-                # same breaker discipline as the scheduler paths: only
-                # TRANSPORT-classified failures are replica-health
-                # evidence — an app error (bad client input) or the
-                # caller's own budget expiring must never eject a
-                # healthy replica
-                if not is_caller_timeout(exc) and is_retryable(exc):
-                    controller._breaker_failure(primary, exc)
-                raise
-            controller._note_attempt_latency(primary, dt)
-            controller._breaker_success(primary)
-            return result
-
-        # ONE try/finally owns both attempt tasks for the whole hedged
-        # call: a caller cancellation anywhere in here (wait_for around
-        # handle.call, client disconnect) must cancel the in-flight
-        # attempts too — cancelling the awaiter never cancels a Task
-        try:
-            delay = (
-                0.0
-                if probing
-                else controller.hedge_delay_s(
-                    self.app_id, self.deployment, options
-                )
-            )
-            done, _ = await asyncio.wait({t_primary}, timeout=delay)
-            if done:
-                # resolved inside the hedge window — no hedge needed;
-                # this path costs one asyncio.wait over a direct await
-                return await resolve_primary_only()
-            try:
-                hedge_replica = controller._pick_replica(
-                    self.app_id,
-                    self.deployment,
-                    avoid=set(tried) | {primary.replica_id},
-                )
-            except (NoHealthyReplicasError, KeyError):
-                hedge_replica = None
-            hedge_budget = budget
-            if deadline is not None:
-                hedge_budget = _min_defined(
-                    options.timeout_s, deadline - time.monotonic()
-                )
-                if hedge_budget is not None and hedge_budget <= 0:
-                    hedge_replica = None
-            if (
-                hedge_replica is None
-                or hedge_replica.replica_id == primary.replica_id
-            ):
-                # nobody distinct to hedge on (single-replica
-                # deployment, or everything else already tried) — ride
-                # the primary
-                return await resolve_primary_only()
-            t_hedge = asyncio.create_task(
-                run(hedge_replica, "hedge", hedge_budget)
-            )
-            owners = {t_primary: primary, t_hedge: hedge_replica}
-            primary_exc: Optional[BaseException] = None
-            hedge_exc: Optional[BaseException] = None
-            pending = set(owners)
-            while pending:
-                done, pending = await asyncio.wait(
-                    pending, return_when=asyncio.FIRST_COMPLETED
-                )
-                for t in done:
-                    target = owners[t]
-                    exc = t.exception()
-                    if exc is None:
-                        result, dt = t.result()
-                        winner = "primary" if t is t_primary else "hedge"
-                        controller._note_attempt_latency(target, dt)
-                        controller._breaker_success(target)
-                        if t is t_hedge and not t_primary.done():
-                            # the primary is about to be cancelled (or
-                            # detached, if probing): not a failure, not
-                            # a sample — but the hedge-loss STREAK is
-                            # the signal that catches a gray replica
-                            # whose own samples hedging dried up
-                            controller._note_hedge_loss(primary)
-                        self._record_hedge(
-                            winner, delay, primary, hedge_replica, method
-                        )
-                        return result
-                    # a GENUINE sub-attempt failure (the loser-cancel
-                    # path never reaches here — cancellation happens in
-                    # the finally below): transport-classified only,
-                    # like every other dispatch path
-                    if not is_caller_timeout(exc) and is_retryable(exc):
-                        controller._breaker_failure(target, exc)
-                    tried.add(target.replica_id)
-                    if t is t_primary:
-                        primary_exc = exc
-                    else:
-                        hedge_exc = exc
-            # both attempts failed — surface the PRIMARY's error so the
-            # outer retry loop classifies exactly what an unhedged
-            # attempt would have raised (the hedge replica already sits
-            # in `tried` for the next failover pick)
-            self._record_hedge(
-                "none", delay, primary, hedge_replica, method
-            )
-            final = primary_exc if primary_exc is not None else hedge_exc
-            raise final
-        finally:
-            if probing and not t_primary.done():
-                detached.add(t_primary)
-                spawn_supervised(
-                    self._settle_probe(t_primary, primary),
-                    name=f"hedge-probe-{self.app_id}-{self.deployment}",
-                    logger=self._controller.logger,
-                )
-            live = [
-                t
-                for t in (t_primary, t_hedge)
-                if t is not None and t not in detached
-            ]
-            for t in live:
-                if not t.done():
-                    t.cancel()
-            # let the cancelled loser unwind its finallys (semaphore
-            # slot, ongoing counter, chip accounting) before returning;
-            # its CancelledError is swallowed HERE and never fed to the
-            # breaker or the outlier EWMA
-            if live:
-                await asyncio.gather(*live, return_exceptions=True)
-
-    async def _settle_probe(self, task: asyncio.Task, target) -> None:
-        """Await a detached probe attempt and bank its evidence: a
-        successful completion feeds the outlier EWMA (the probe's whole
-        point), a genuine transport failure feeds the breaker, and the
-        caller who detached it is long gone either way."""
-        controller = self._controller
-        try:
-            result, dt = await task
-        except asyncio.CancelledError:
-            return
-        except Exception as exc:  # noqa: BLE001 — classified below
-            if not is_caller_timeout(exc) and classify_exception(
-                exc
-            ) is FailureKind.TRANSPORT:
-                controller._breaker_failure(target, exc)
-            return
-        controller._note_attempt_latency(target, dt)
-
-    def _record_hedge(
-        self, winner: str, delay: float, primary, hedge_replica, method: str
-    ) -> None:
-        if metrics.metrics_enabled():
-            child = self._m_hedges.get(winner)
-            if child is None:
-                child = self._m_hedges[winner] = REQUEST_HEDGES.labels(
-                    self.app_id, self.deployment, winner
-                )
-            child.inc()
-        flight.record(
-            "request.hedge",
-            app=self.app_id,
-            deployment=self.deployment,
-            method=method,
-            winner=winner,
-            delay_ms=round(delay * 1000.0, 2),
-            primary=primary.replica_id,
-            hedge=hedge_replica.replica_id,
-        )
-
-    def __getattr__(self, name: str):
-        if name.startswith("_"):
-            raise AttributeError(name)
-
-        async def invoke(*args, **kwargs):
-            return await self.call(name, *args, **kwargs)
-
-        invoke.__name__ = name
-        return invoke
-
-
-def _min_defined(*values: Optional[float]) -> Optional[float]:
-    present = [v for v in values if v is not None]
-    return min(present) if present else None
-
-
-class ServeController:
+class ServeController(RouterCore):
     def __init__(
         self,
         cluster_state: Optional[ClusterState] = None,
@@ -960,37 +246,20 @@ class ServeController:
         self.cluster_state = cluster_state or ClusterState()
         self.health_check_period = health_check_period
         self.health_check_concurrency = health_check_concurrency
-        # per-replica circuit breaker: K consecutive transport failures
-        # eject the replica immediately (no waiting for the health tick)
-        self.breaker_threshold = (
-            breaker_threshold
-            if breaker_threshold is not None
-            else int(os.environ.get("BIOENGINE_BREAKER_THRESHOLD", "3"))
-        )
-        # routable-replica wait during restart windows when the request
-        # carries no deadline (read once — this sits on the hot path)
-        self.pick_replica_grace_s = float(
-            os.environ.get("BIOENGINE_PICK_REPLICA_WAIT_S", "10")
-        )
         self.apps: dict[str, AppDeployment] = {}
         self.logger = create_logger("serving", log_file=log_file)
         self._health_task: Optional[asyncio.Task] = None
-        self._wake_health = asyncio.Event()   # breaker trips ring this
-        self._queue_depth: dict[tuple[str, str], int] = defaultdict(int)
-        self._rr_counters: dict[tuple[str, str], itertools.count] = {}
-        self._breaker_counts: dict[str, int] = {}
-        # gray-failure defense (serving/outlier.py): per-deployment
-        # latency trackers feeding the PROBATION soft-ejection + the
-        # p95-derived hedge delay; created lazily on first observation,
-        # swept at undeploy like every other router-state dict
-        self.outlier_config = outlier_config or OutlierConfig.from_env()
-        self._outliers: dict[tuple[str, str], DeploymentLatencyTracker] = {}
-        # global schedulers, one per deployment that opted in via
-        # DeploymentSpec.scheduling; created at deploy, closed at
-        # undeploy. scorer_factory is the pluggable placement policy —
-        # swap in a learned scorer without touching the scheduler.
-        self._schedulers: dict[tuple[str, str], DeploymentScheduler] = {}
-        self.scorer_factory: Callable[[], Any] = HeuristicCostModel
+        # the whole request path — breaker, outlier probation, replica
+        # pick/wait, rr counters, queue depth, scheduler registry —
+        # comes from RouterCore (serving/router.py), shared verbatim
+        # with the standalone router tier
+        self._init_router_core(
+            breaker_threshold=breaker_threshold,
+            outlier_config=outlier_config,
+        )
+        # versioned routing-table publication for the scale-out router
+        # tier (served over serve-router.get_routing_table)
+        self.router_publisher = RoutingTablePublisher(self)
         # warm pools, one per deployment that opted in via
         # DeploymentSpec.warm_pool; standbys live here, OUT of the
         # routing set, until a scale-up/preemption promotes them
@@ -998,7 +267,6 @@ class ServeController:
         # controller-side shared compile-cache tier (served to worker
         # hosts over the compile_cache_* verbs once attach_rpc runs)
         self.compile_tier = CompileCacheTier()
-        self._replicas_changed = asyncio.Event()
         self._rpc_server = None            # set by attach_rpc (multi-host)
         self._router_admins: list[str] = []
         # telemetry history + SLO engine (the proactive half of the
@@ -1222,6 +490,22 @@ class ServeController:
             )
             return {"name": name, "stored": self.compile_tier.publish(name, blob)}
 
+        def get_routing_table(
+            router_id=None, since_version=0, staleness_s=None, context=None
+        ):
+            # the scale-out router tier syncs its epoch-stamped table
+            # here (serving/router.py StandaloneRouter.sync_once);
+            # admin-gated like the other control verbs — a router holds
+            # the same token a worker host does
+            check_permissions(
+                context, self._router_admins, "get_routing_table"
+            )
+            return self.router_publisher.table(
+                since_version=int(since_version or 0),
+                router_id=router_id,
+                staleness_s=staleness_s,
+            )
+
         server.register_local_service(
             {
                 "id": "serve-router",
@@ -1238,6 +522,7 @@ class ServeController:
                 "compile_cache_list": compile_cache_list,
                 "compile_cache_fetch": compile_cache_fetch,
                 "compile_cache_publish": compile_cache_publish,
+                "get_routing_table": get_routing_table,
             }
         )
 
@@ -2370,263 +1655,6 @@ class ServeController:
             self._breaker_counts.pop(replica.replica_id, None)
             self._forget_replica_latency(replica.replica_id)
 
-    # ---- request routing ----------------------------------------------------
-
-    def get_handle(
-        self,
-        app_id: str,
-        deployment: Optional[str] = None,
-        options: Optional[RequestOptions] = None,
-    ) -> DeploymentHandle:
-        app = self.apps.get(app_id)
-        if app is None:
-            raise KeyError(f"app '{app_id}' not deployed")
-        if deployment is None:
-            deployment = next(iter(app.specs))
-        if deployment not in app.specs:
-            raise KeyError(f"app '{app_id}' has no deployment '{deployment}'")
-        self._queue_depth.setdefault((app_id, deployment), 0)
-        return DeploymentHandle(self, app_id, deployment, options)
-
-    def _pick_replica(
-        self, app_id: str, deployment: str, avoid: Optional[set] = None
-    ) -> Replica:
-        """Least-loaded routable replica, round-robin tie-break.
-        ``avoid`` holds replica_ids that already failed THIS request —
-        preferred against, but used as a last resort (the replica may
-        have recovered and being wrong just costs one more retry).
-
-        PROBATION replicas (latency outliers, serving/outlier.py) are
-        soft-ejected: skipped by the pick except for the trickle probe
-        (every Nth pick routes one real request there so recovery is
-        observed) — and as the last resort when nothing else is
-        routable, because slow beats unavailable."""
-        app = self.apps.get(app_id)
-        if app is None:
-            raise KeyError(f"app '{app_id}' not deployed")
-        healthy = [
-            r
-            for r in app.replicas.get(deployment, [])
-            if r.state in ROUTABLE_STATES
-        ]
-        if avoid:
-            preferred = [r for r in healthy if r.replica_id not in avoid]
-            healthy = preferred or healthy
-        if not healthy:
-            raise NoHealthyReplicasError(
-                f"no healthy replicas for {app_id}/{deployment}"
-            )
-        probation = [
-            r for r in healthy if r.state == ReplicaState.PROBATION
-        ]
-        normal = [
-            r for r in healthy if r.state != ReplicaState.PROBATION
-        ]
-        if probation and normal:
-            tracker = self._outlier_tracker(app_id, deployment)
-            if tracker.take_probe_ticket():
-                # the probe trickle: route ONE real request to a
-                # probation replica so its latency keeps being measured
-                # — recovery is self-correcting, not operator-driven
-                healthy = probation
-            else:
-                healthy = normal
-        min_load = min(r.load for r in healthy)
-        candidates = [r for r in healthy if r.load == min_load]
-        rr = self._rr_counters.setdefault(
-            (app_id, deployment), itertools.count()
-        )
-        return candidates[next(rr) % len(candidates)]
-
-    async def _pick_replica_wait(
-        self,
-        app_id: str,
-        deployment: str,
-        avoid: Optional[set] = None,
-        deadline: Optional[float] = None,
-    ) -> Replica:
-        """Like ``_pick_replica`` but WAITS through a restart window
-        (bounded by the request deadline, or a default grace period)
-        instead of raising instantly — a replica being re-placed after
-        a host death is invisible to callers that can afford to wait."""
-        wait_until = (
-            deadline
-            if deadline is not None
-            else time.monotonic() + self.pick_replica_grace_s
-        )
-        while True:
-            try:
-                return self._pick_replica(app_id, deployment, avoid=avoid)
-            except NoHealthyReplicasError:
-                remaining = wait_until - time.monotonic()
-                if remaining <= 0:
-                    raise
-                self._replicas_changed.clear()
-                try:
-                    # woken early when a replica is (re-)placed
-                    await asyncio.wait_for(
-                        self._replicas_changed.wait(), min(remaining, 0.25)
-                    )
-                except asyncio.TimeoutError:
-                    pass
-
-    # ---- circuit breaker ----------------------------------------------------
-
-    def _breaker_failure(self, replica, exc: Exception) -> None:
-        """Record one transport failure. At ``breaker_threshold``
-        consecutive failures the replica is ejected NOW (marked
-        UNHEALTHY + health loop woken) instead of waiting out the
-        health period."""
-        rid = replica.replica_id
-        n = self._breaker_counts.get(rid, 0) + 1
-        self._breaker_counts[rid] = n
-        if n >= self.breaker_threshold and replica.state in ROUTABLE_STATES:
-            replica.state = ReplicaState.UNHEALTHY
-            replica.last_error = (
-                f"circuit breaker opened after {n} consecutive transport "
-                f"failures (last: {exc})"
-            )
-            self.logger.warning(
-                f"breaker ejected replica {rid} after {n} transport failures"
-            )
-            if metrics.metrics_enabled():
-                BREAKER_TRIPS.labels(
-                    replica.app_id, replica.deployment_name
-                ).inc()
-            flight.record(
-                "breaker.trip",
-                severity="error",
-                replica=rid,
-                app=replica.app_id,
-                deployment=replica.deployment_name,
-                host=getattr(replica, "host_id", None),
-                failures=n,
-                error=str(exc)[:500],
-            )
-            # the postmortem moment: snapshot the ring while the events
-            # leading up to the trip are still in it
-            flight.dump("breaker_trip", replica=rid, app=replica.app_id)
-            self._wake_health.set()
-
-    def _breaker_success(self, replica) -> None:
-        if self._breaker_counts.pop(replica.replica_id, None):
-            flight.record(
-                "breaker.reset",
-                replica=replica.replica_id,
-                app=replica.app_id,
-                deployment=replica.deployment_name,
-            )
-
-    # ---- gray-failure defense (latency outliers → probation) ----------------
-
-    def _outlier_tracker(
-        self, app_id: str, deployment: str
-    ) -> DeploymentLatencyTracker:
-        key = (app_id, deployment)
-        tracker = self._outliers.get(key)
-        if tracker is None:
-            tracker = self._outliers[key] = DeploymentLatencyTracker(
-                app_id, deployment, self.outlier_config
-            )
-        return tracker
-
-    def _note_attempt_latency(self, replica, seconds: float) -> None:
-        """Feed one SUCCESSFUL attempt's service time into the
-        deployment's outlier tracker and apply the probation verdicts
-        it returns (possibly for OTHER replicas of the deployment — a
-        hedged-around gray replica stops producing samples of its own,
-        so its excursion matures on its siblings' notes). Called by the
-        router path, the scheduler's fast path, and group dispatch —
-        never for failed attempts (their wall time measures the
-        transport) and never for cancelled hedge losers (their wall
-        time measures the winner)."""
-        tracker = self._outlier_tracker(
-            replica.app_id, replica.deployment_name
-        )
-        transitions = tracker.note(replica.replica_id, seconds)
-        self._apply_probation_transitions(tracker, replica, transitions)
-
-    def _note_hedge_loss(self, replica) -> None:
-        """A hedge fired against ``replica`` and won. Not a breaker
-        strike, not an EWMA sample — but the tracker counts the streak
-        (see ``note_hedge_loss``) and may return probation verdicts."""
-        tracker = self._outlier_tracker(
-            replica.app_id, replica.deployment_name
-        )
-        transitions = tracker.note_hedge_loss(replica.replica_id)
-        self._apply_probation_transitions(tracker, replica, transitions)
-
-    def _apply_probation_transitions(
-        self, tracker, replica, transitions
-    ) -> None:
-        if not transitions:
-            return
-        app_id = replica.app_id
-        deployment = replica.deployment_name
-        app = self.apps.get(app_id)
-        by_id = {
-            r.replica_id: r
-            for r in (app.replicas.get(deployment, []) if app else [])
-        }
-        by_id.setdefault(replica.replica_id, replica)
-        median = tracker._median()
-        for rid, transition in transitions:
-            target = by_id.get(rid)
-            if target is None:
-                tracker.forget(rid)  # retired mid-flight — stale entry
-                continue
-            ewma = tracker.ewma(rid)
-            # a streak-entered replica may have NO measured EWMA at all
-            # (every completion was a cancelled hedge loser) — the
-            # evidence attrs must tolerate that, not crash the hedged
-            # request that triggered the verdict
-            ewma_s = None if ewma is None else round(ewma, 6)
-            median_s = None if median is None else round(median, 6)
-            if transition == "enter":
-                if target.state != ReplicaState.HEALTHY:
-                    # TESTING replicas are still warming (compile spikes
-                    # are not gray failure) and DRAINING/UNHEALTHY ones
-                    # are already out of the pick — roll the verdict back
-                    tracker.replicas[rid].in_probation = False
-                    continue
-                target.state = ReplicaState.PROBATION
-                self.logger.warning(
-                    f"replica {rid} entered probation: latency EWMA "
-                    f"{ewma_s}s vs deployment median {median_s}s "
-                    f"(gray failure — health checks still pass)"
-                )
-                if metrics.metrics_enabled():
-                    REPLICA_PROBATIONS.labels(app_id, deployment).inc()
-                record_probation_event(
-                    app_id, deployment, rid, "enter",
-                    ewma_s=ewma_s, median_s=median_s,
-                    host=getattr(target, "host_id", None),
-                )
-            elif transition == "exit":
-                if target.state == ReplicaState.PROBATION:
-                    target.state = ReplicaState.HEALTHY
-                    self._replicas_changed.set()
-                self.logger.info(
-                    f"replica {rid} recovered from probation "
-                    f"(EWMA {ewma_s}s, median {median_s}s)"
-                )
-                record_probation_event(
-                    app_id, deployment, rid, "exit",
-                    ewma_s=ewma_s, median_s=median_s,
-                    host=getattr(target, "host_id", None),
-                )
-
-    def _forget_replica_latency(self, replica_id: str) -> None:
-        for tracker in self._outliers.values():
-            tracker.forget(replica_id)
-
-    def hedge_delay_s(
-        self, app_id: str, deployment: str, options: "RequestOptions"
-    ) -> float:
-        if options.hedge_delay_s is not None:
-            return options.hedge_delay_s
-        return self._outlier_tracker(app_id, deployment).hedge_delay_s()
-
     # ---- health + autoscaling loop ------------------------------------------
 
     async def _health_loop(self) -> None:
@@ -2914,6 +1942,9 @@ class ServeController:
                 "phase": self.phase,
                 "reconcile": self.reconcile_report,
             },
+            # the scale-out router tier: table version/epoch plus each
+            # router's last-reported sync (acked version, staleness age)
+            "router_tier": self.router_publisher.describe(),
             "cost": self._cost_rollup(app_id),
             "deployments": {
                 name: self._describe_deployment(app_id, name, replicas)

@@ -74,6 +74,23 @@ class StaleEpochError(RuntimeError):
         self.got_epoch = got_epoch
 
 
+class StaleTableError(StaleEpochError):
+    """A routing-table push carried a LOWER journal epoch (or a lower
+    version under the SAME epoch) than the router already holds — the
+    publisher is a wedged-then-revived old controller, or the push was
+    reordered in flight. Rejected typed so the stale table can never
+    regress a router's newer view; inherits the non-retryable
+    classification of :class:`StaleEpochError` (re-pushing the same
+    stale table can never succeed)."""
+
+
+class RouterClosedError(RetryableTransportError):
+    """The standalone router this request landed on is shutting down
+    (or was killed) and admits no new requests. Retryable by design:
+    the routing tier is stateless-per-request, so the client's typed
+    retry machinery fails the request over to a sibling router."""
+
+
 class AdmissionRejectedError(RuntimeError):
     """The global scheduler shed this request at admission (queue depth
     over budget, tenant quota exhausted, or a deadline that could never
@@ -87,6 +104,18 @@ class AdmissionRejectedError(RuntimeError):
     def __init__(self, message: str, reason: str = "queue_full"):
         super().__init__(message)
         self.reason = reason
+
+
+class RouterSaturatedError(AdmissionRejectedError):
+    """The standalone router this request landed on is at its inflight
+    cap (``BIOENGINE_ROUTER_MAX_INFLIGHT``). Same non-retryable
+    backpressure semantics as its parent — every sibling router shares
+    the replica pool, so failing over would just move the overload —
+    but typed so dashboards can tell router saturation apart from a
+    scheduler queue rejection."""
+
+    def __init__(self, message: str):
+        super().__init__(message, reason="router_saturated")
 
 
 class DeadlineExceeded(asyncio.TimeoutError):

@@ -114,7 +114,7 @@ class FaultEvent:
 
     at_tick: int
     # kill_host | respawn_host | slow_ramp | blip | clear_faults |
-    # kill_controller | restart_controller | stale_verb
+    # kill_controller | restart_controller | stale_verb | kill_router
     action: str
     host: Optional[str] = None
     delay_s: float = 0.2         # slow_ramp target delay
@@ -168,6 +168,23 @@ class Scenario:
     # idempotent requests" across a control-plane restart (in-replica
     # failover can't help when the router itself is gone)
     client_retry: bool = False
+    # scale-out router tier: n_routers > 0 → requests route through
+    # StandaloneRouters fed by the controller's routing-table publisher
+    # (clients spread round-robin by request index and fail over to a
+    # sibling router on RouterClosedError — the typed-retry contract)
+    n_routers: int = 0
+    # per-router inflight admission cap (None → unbounded); the knob
+    # that makes the fleet-scale goodput capacity-bound per router
+    router_max_inflight: Optional[int] = None
+    router_sync_every: int = 2   # table sync cadence, in ticks
+    # bounded-staleness assertion input: max observed table age (seconds,
+    # sampled just BEFORE each sync — the worst age a live router served
+    # from), scaled by BIOENGINE_SCENARIO_SCALE
+    router_staleness_bound_s: Optional[float] = None
+    # fleet dressing: register N synthetic mesh hosts in ClusterState so
+    # the published routing table carries a fleet-scale host membership
+    # block (replicas stay local — the routing work is what's under test)
+    sim_hosts: int = 0
 
 
 # ---------------------------------------------------------------------------
@@ -296,6 +313,11 @@ class _Plane:
         # SIGKILL'd controllers, kept so stale_verb can replay a
         # lower-epoch verb from them (the split-brain probe)
         self.old_controllers: list[Any] = []
+        # scale-out router tier (scenario.n_routers > 0)
+        self.routers: list[Any] = []
+        self.killed_routers: list[str] = []
+        self.router_failovers = 0          # client hops to a sibling
+        self.staleness_samples: list[float] = []
         self.app_id = "scenario-app"
         self.deployment = "scenario_dep"
 
@@ -361,6 +383,74 @@ class _Plane:
                     )
                 ],
             )
+        if s.sim_hosts > 0:
+            self._register_sim_hosts()
+        if s.n_routers > 0:
+            self._start_routers()
+
+    def _register_sim_hosts(self) -> None:
+        """Fleet dressing: N synthetic mesh hosts in ClusterState so the
+        published routing table carries a fleet-scale membership block.
+        Safe because the local plane has no RPC server — the dead-host
+        prune is a no-op — and the hosts lease no chips."""
+        from bioengine_tpu.cluster.state import HostRecord
+
+        now = time.time()
+        for i in range(self.scenario.sim_hosts):
+            hid = f"sim{i}"
+            self.controller.cluster_state.hosts[hid] = HostRecord(
+                host_id=hid,
+                service_id=f"svc-{hid}",
+                topology={"n_chips": 4, "chips": []},
+                registered_at=now,
+            )
+
+    def _start_routers(self) -> None:
+        """Bring up the standalone router tier against the controller's
+        routing-table publisher. The resolver re-reads ``self.controller``
+        per lookup so a controller restart transparently re-resolves."""
+        from bioengine_tpu.serving import (
+            StandaloneRouter,
+            shared_object_resolver,
+        )
+
+        s = self.scenario
+        resolver = shared_object_resolver(lambda: self.controller)
+        for i in range(s.n_routers):
+            router = StandaloneRouter(
+                f"r{i}",
+                resolver,
+                outlier_config=self._outlier,
+                max_inflight=s.router_max_inflight,
+            )
+            router.sync_from(self.controller)
+            self.routers.append(router)
+
+    def sync_routers(self) -> None:
+        """One table-sync round. Staleness is sampled BEFORE syncing —
+        the worst age each live router actually served from — feeding
+        the bounded-staleness invariant. A failed sync (controller
+        mid-restart) keeps the last-good table: staleness grows, routing
+        continues."""
+        for router in self.routers:
+            if router.closed:
+                continue
+            self.staleness_samples.append(router.table_staleness_s)
+            try:
+                router.sync_from(self.controller)
+            except Exception as e:  # noqa: BLE001 — stale table keeps serving
+                logger.debug(
+                    f"router {router.router_id} sync failed: {e}"
+                )
+
+    def kill_router(self, router_id: Optional[str]) -> None:
+        for router in self.routers:
+            if router.router_id == router_id:
+                router.kill()
+                self.killed_routers.append(router.router_id)
+                logger.info(f"scenario: router {router_id} killed")
+                return
+        raise ValueError(f"kill_router: unknown router '{router_id}'")
 
     def _make_controller(self):
         from bioengine_tpu.cluster.state import ClusterState
@@ -519,10 +609,15 @@ class _Plane:
             await self.restart_controller()
         elif ev.action == "stale_verb":
             await self.stale_verb()
+        elif ev.action == "kill_router":
+            self.kill_router(ev.host)
         else:
             raise ValueError(f"unknown fault action '{ev.action}'")
 
     async def stop(self) -> None:
+        for router in self.routers:
+            if not router.closed:
+                router.kill()
         for host in list(self.hosts.values()) + list(self.dead_hosts.values()):
             try:
                 await host.stop()
@@ -553,6 +648,7 @@ async def run_scenario_async(
     from bioengine_tpu.serving.errors import (
         AdmissionRejectedError,
         DeadlineExceeded,
+        RouterClosedError,
     )
 
     scale = _scale()
@@ -627,9 +723,20 @@ async def run_scenario_async(
             # PLANE, not any one controller instance — exactly a real
             # client reconnecting to the healed control-plane URL
             budget_until = t0 + (opts.deadline_s or s.deadline_s * scale)
+            # router tier: clients spread round-robin by request index;
+            # a RouterClosedError (typed-retryable) hops to the next
+            # sibling — each request tries at most every router once
+            n_routers = len(plane.routers)
+            router_offset = 0
             while True:
                 try:
-                    handle = plane.controller.get_handle(
+                    if n_routers:
+                        target = plane.routers[
+                            (idx + router_offset) % n_routers
+                        ]
+                    else:
+                        target = plane.controller
+                    handle = target.get_handle(
                         plane.app_id, plane.deployment
                     )
                     r = await handle.call(
@@ -639,6 +746,12 @@ async def run_scenario_async(
                     outcomes[idx] = (
                         "ok" if got == req["a"] + req["b"] else "wrong_result"
                     )
+                except RouterClosedError:
+                    router_offset += 1
+                    plane.router_failovers += 1
+                    if router_offset < n_routers:
+                        continue
+                    outcomes[idx] = "failed:RouterClosedError"
                 except AdmissionRejectedError:
                     outcomes[idx] = "shed"
                 except DeadlineExceeded:
@@ -672,7 +785,12 @@ async def run_scenario_async(
             await asyncio.sleep(s.tick_s * scale)
             queue_samples.append(
                 sum(plane.controller._queue_depth.values())
+                + sum(
+                    sum(r._queue_depth.values()) for r in plane.routers
+                )
             )
+            if plane.routers and tick % s.router_sync_every == 0:
+                plane.sync_routers()
             if tick % s.health_every == 0:
                 await plane.controller.health_tick()
         # drain: every request finishes (deadlines bound this), then the
@@ -789,6 +907,11 @@ def _evaluate(
         "no_duplicate_placements": lambda: _inv_no_duplicates(plane),
         "epoch_fencing_observed": lambda: _inv_fencing(flight_t0),
         "replicas_adopted": lambda: _inv_adopted(flight_t0),
+        "router_failover_observed": lambda: (
+            plane.router_failovers > 0,
+            f"{plane.router_failovers} client hop(s) to a sibling router",
+        ),
+        "router_staleness_bounded": lambda: _inv_router_staleness(s, plane),
     }
 
     invariants: dict[str, dict] = {}
@@ -806,6 +929,25 @@ def _evaluate(
     counts: dict[str, int] = {}
     for out in seq:
         counts[out] = counts.get(out, 0) + 1
+    routers_section = None
+    if plane.routers:
+        routers_section = {
+            "count": len(plane.routers),
+            "killed": list(plane.killed_routers),
+            "client_failovers": plane.router_failovers,
+            # raw (un-normalized) served count — the goodput numerator
+            # the router_scaling bench reads; best-effort capacity legs
+            # normalize seq to "absorbed" but goodput wants the truth
+            "raw_ok": sum(1 for out in outcomes if out == "ok"),
+            "staleness_max_s": (
+                round(max(plane.staleness_samples), 4)
+                if plane.staleness_samples
+                else None
+            ),
+            "staleness_samples": len(plane.staleness_samples),
+            "table_epoch": plane.routers[0].table_epoch,
+            "per_router": [r.describe() for r in plane.routers],
+        }
     return {
         "scenario": s.name,
         "seed": seed,
@@ -833,6 +975,7 @@ def _evaluate(
             if e["attrs"].get("phase") == "enter"
         ),
         "hedges": len(hedge_events),
+        "routers": routers_section,
     }
 
 
@@ -902,12 +1045,16 @@ def _inv_no_stuck(plane: _Plane) -> tuple[bool, str]:
         conn = host.connection
         if conn is not None and conn._pending:
             problems.append(f"{host_id} pending: {len(conn._pending)}")
-    for key, sched in plane.controller._schedulers.items():
-        if sched.waiting or sched._open or sched._inflight:
-            problems.append(
-                f"scheduler {key}: waiting={sched.waiting} "
-                f"open={len(sched._open)} inflight={len(sched._inflight)}"
-            )
+    sched_owners = [("controller", plane.controller)] + [
+        (r.router_id, r) for r in plane.routers
+    ]
+    for owner, core in sched_owners:
+        for key, sched in core._schedulers.items():
+            if sched.waiting or sched._open or sched._inflight:
+                problems.append(
+                    f"{owner} scheduler {key}: waiting={sched.waiting} "
+                    f"open={len(sched._open)} inflight={len(sched._inflight)}"
+                )
     lingering = [
         t for t in task_registry._BACKGROUND_TASKS if not t.done()
     ]
@@ -921,9 +1068,25 @@ def _inv_bounded_queues(
 ) -> tuple[bool, str]:
     bound = s.n_replicas * s.max_ongoing * 4
     peak = max(queue_samples, default=0)
-    final = sum(plane.controller._queue_depth.values())
+    final = sum(plane.controller._queue_depth.values()) + sum(
+        sum(r._queue_depth.values()) for r in plane.routers
+    )
     ok = peak <= bound and final == 0
     return ok, f"peak={peak} bound={bound} final={final}"
+
+
+def _inv_router_staleness(s: Scenario, plane: _Plane) -> tuple[bool, str]:
+    """Every live router's table age, sampled just before each sync
+    round, stays under the scenario's bound — the 'routers serve a
+    bounded-staleness view' contract."""
+    if not plane.staleness_samples:
+        return False, "no staleness samples (router tier absent?)"
+    bound = (s.router_staleness_bound_s or 1.0) * _scale()
+    worst = max(plane.staleness_samples)
+    return worst <= bound, (
+        f"max table age {1000 * worst:.0f}ms <= bound "
+        f"{1000 * bound:.0f}ms over {len(plane.staleness_samples)} samples"
+    )
 
 
 def _inv_slo(s: Scenario, strict_lat: list) -> tuple[bool, str]:
@@ -1273,6 +1436,87 @@ CONTROLLER_CRASH = _register(
 )
 
 
+# The scale-out routing-tier capacity scenario (and the workload under
+# the router_scaling bench): hundreds of simulated mesh hosts in the
+# published table, a large local replica pool, and offered load far
+# over what ONE router's inflight cap can admit. Goodput is therefore
+# capacity-bound per router — adding routers adds admitted goodput
+# near-linearly until the offered load is fully served. The stream is
+# best-effort (strict=False): shed-at-the-router is the designed
+# behavior for the over-subscribed legs, so ok/shed normalize to
+# "absorbed" and the raw served count rides in result["routers"].
+FLEET_SCALE = _register(
+    Scenario(
+        name="fleet_scale",
+        description=(
+            "fleet-scale routing-table fan-out: offered load beyond one "
+            "router's admission capacity; goodput scales with routers"
+        ),
+        ticks=40,
+        tick_s=0.015,
+        health_every=1000,       # one pass at tick 0 — no churn to heal
+        n_hosts=0,
+        n_replicas=160,
+        sim_hosts=320,
+        max_ongoing=16,
+        service_s=0.05,
+        n_routers=4,
+        router_max_inflight=8,
+        router_sync_every=2,
+        router_staleness_bound_s=1.0,
+        streams=(Stream(name="fleet", strict=False, base=24,
+                        deadline_s=5.0),),
+        hedge=False,             # capacity probe — no duplicate attempts
+        deadline_s=5.0,
+        slo_ms=5000.0,
+        invariants=(
+            "no_stuck_futures",
+            "bounded_queues",
+            "router_staleness_bounded",
+        ),
+    )
+)
+
+
+# The router-loss acceptance scenario: three routers, one SIGKILL'd
+# mid-traffic. In-flight requests on the dead router finish (kill only
+# closes admission); new arrivals that land on it get the typed
+# RouterClosedError and hop to a sibling — zero idempotent loss, and
+# the surviving routers' table staleness stays bounded throughout.
+ROUTER_LOSS = _register(
+    Scenario(
+        name="router_loss",
+        description=(
+            "SIGKILL one of three routers mid-traffic; clients fail "
+            "over to siblings typed, zero idempotent loss"
+        ),
+        ticks=80,
+        tick_s=0.015,
+        health_every=4,
+        n_hosts=0,
+        n_replicas=6,
+        max_ongoing=16,
+        service_s=0.01,
+        n_routers=3,
+        router_sync_every=2,
+        router_staleness_bound_s=1.0,
+        streams=(Stream(base=3),),
+        hedge=False,
+        fault_script=(
+            FaultEvent(at_tick=30, action="kill_router", host="r1"),
+        ),
+        slo_ms=1000.0,
+        invariants=(
+            "zero_failed_idempotent",
+            "no_stuck_futures",
+            "bounded_queues",
+            "router_failover_observed",
+            "router_staleness_bounded",
+        ),
+    )
+)
+
+
 def get_scenario(name: str) -> Scenario:
     try:
         return NAMED_SCENARIOS[name]
@@ -1291,6 +1535,7 @@ def list_scenarios() -> list[dict]:
             "ticks": s.ticks,
             "hosts": s.n_hosts,
             "replicas": s.n_replicas,
+            "routers": s.n_routers,
             "scheduled": s.scheduling is not None,
             "faults": [
                 {"tick": ev.at_tick, "action": ev.action, "host": ev.host}

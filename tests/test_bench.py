@@ -494,6 +494,77 @@ def test_gray_failure_stage_schema():
     assert st["tail_p99_improvement"] > 1.0, st
 
 
+def test_router_scaling_stage_schema():
+    """Pin the router_scaling artifact schema: the fleet_scale scenario
+    run per router count, goodput capacity-bound per router so the
+    4-router leg must reach >= 3x the 1-router goodput; the router_loss
+    leg (one of three routers SIGKILL'd mid-traffic) must lose zero
+    idempotent requests; and the seam probe reports serial per-request
+    overhead through a table-synced standalone router vs the in-process
+    controller path. Legs pinned to 1,4 to keep the gate fast — the
+    default 1,2,4,8 sweep is the bench-artifact run."""
+    proc, lines = _run(
+        {
+            "BENCH_CONFIGS": "router_scaling",
+            "BENCH_ROUTER_LEGS": "1,4",
+            "BENCH_DEADLINE": "280",
+        },
+        timeout=320.0,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    st = json.loads(lines[-1])["extra"]["router_scaling"]
+    assert st["ok"], st
+    for key in (
+        "scenario",
+        "seed",
+        "legs",
+        "goodput_scaling_4x_vs_1",
+        "router_loss",
+        "per_request_overhead_us",
+    ):
+        assert key in st, key
+    assert st["scenario"] == "fleet_scale"
+    for name in ("1", "4"):
+        leg = st["legs"][name]
+        for key in (
+            "routers",
+            "offered",
+            "served",
+            "wall_s",
+            "goodput_rps",
+            "table_staleness_max_s",
+            "invariants_ok",
+        ):
+            assert key in leg, (name, key)
+        assert leg["invariants_ok"] is True, leg
+        assert leg["goodput_rps"] > 0, leg
+        # bounded staleness is measured, not just asserted green
+        assert leg["table_staleness_max_s"] is not None, leg
+    # the acceptance gate: aggregate goodput scales near-linearly
+    assert st["goodput_scaling_4x_vs_1"] >= 3.0, st
+    loss = st["router_loss"]
+    for key in (
+        "requests",
+        "failed_idempotent",
+        "client_failovers",
+        "killed",
+        "table_staleness_max_s",
+        "invariants_ok",
+    ):
+        assert key in loss, key
+    # zero idempotent loss across the router kill, and the clients
+    # actually hopped to a sibling (the kill engaged)
+    assert loss["failed_idempotent"] == 0, loss
+    assert loss["client_failovers"] > 0, loss
+    assert loss["killed"] == ["r1"], loss
+    assert loss["invariants_ok"] is True, loss
+    probe = st["per_request_overhead_us"]
+    for key in ("controller", "router", "router_delta_us_p50"):
+        assert key in probe, key
+    for leg in ("controller", "router"):
+        assert probe[leg]["p50_us"] > 0, probe
+
+
 def _artifact(vit=1000.0, pipelined=2.0, p50_us=100.0) -> dict:
     """A minimal bench artifact in the real schema, tunable per metric."""
     return {

@@ -1,0 +1,539 @@
+"""Scale-out router tier: table publication, epoch fencing, the
+standalone router's request path, and the shared-contract pins.
+
+What the suite proves, layer by layer:
+
+- **Publication** (``RoutingTablePublisher``): versions advance only on
+  content changes, diffs carry only what changed, advisory hints (load,
+  breaker counts) ride along without churning versions.
+- **Fencing** (``StandaloneRouter.apply_table``): a stale controller's
+  push — lower epoch, or lower version under the same epoch — is
+  rejected TYPED (``StaleTableError``) and never regresses the router's
+  newer view; a diff cannot cross controller generations; epochs come
+  from the real PR 15 journal (two controllers minting against one
+  ``control_dir``), not hand-rolled counters.
+- **Serving** (``shared_object_resolver`` / ``remote_replica_resolver``):
+  a synced router routes the identical ``RouterCore`` path the
+  controller runs, keeps serving its last-good table when pushes go
+  stale, sheds typed at its inflight cap, and fails new requests over
+  typed when killed.
+- **Contract** (the bugfix-sweep pin): exactly ONE copy of the
+  breaker/caller-timeout exemption and of the ``_best_replica`` scorer
+  argmin exists in the tree, and the router half of the old controller
+  lives ONLY in ``RouterCore`` — no drift between the in-process and
+  standalone paths is possible because there is nothing to drift.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+from pathlib import Path
+
+import pytest
+
+from bioengine_tpu.cluster.state import ClusterState
+from bioengine_tpu.serving import (
+    DeploymentSpec,
+    ReplicaState,
+    RequestOptions,
+    RouterCore,
+    SchedulingConfig,
+    ServeController,
+    StandaloneRouter,
+    remote_replica_resolver,
+    shared_object_resolver,
+)
+from bioengine_tpu.serving.errors import (
+    AdmissionRejectedError,
+    RetryableTransportError,
+    RouterClosedError,
+    RouterSaturatedError,
+    StaleEpochError,
+    StaleTableError,
+)
+from bioengine_tpu.serving.router import TABLE_SCHEMA, DeploymentHandle
+from bioengine_tpu.utils import metrics
+
+pytestmark = [pytest.mark.integration, pytest.mark.anyio]
+
+SRC_ROOT = Path(__file__).resolve().parent.parent / "bioengine_tpu"
+
+
+class _Echo:
+    async def work(self, a: int = 0, b: int = 0):
+        return {"sum": a + b}
+
+
+class _Slow:
+    async def work(self, a: int = 0, b: int = 0):
+        await asyncio.sleep(0.2)
+        return {"sum": a + b}
+
+
+async def _deploy(controller, factory=_Echo, n=2, scheduling=None,
+                  app_id="app", dep="dep"):
+    await controller.deploy(
+        app_id,
+        [
+            DeploymentSpec(
+                name=dep,
+                instance_factory=factory,
+                num_replicas=n,
+                min_replicas=n,
+                max_replicas=n,
+                autoscale=False,
+                scheduling=scheduling,
+            )
+        ],
+    )
+    return controller
+
+
+@pytest.fixture
+async def controller():
+    c = ServeController(ClusterState(), health_check_period=3600)
+    await _deploy(c)
+    yield c
+    await c.stop()
+
+
+# ---------------------------------------------------------------------------
+# publication
+# ---------------------------------------------------------------------------
+
+
+class TestTablePublication:
+    async def test_full_table_schema(self, controller):
+        t = controller.router_publisher.table()
+        assert t["schema"] == TABLE_SCHEMA
+        assert t["full"] is True
+        assert t["epoch"] == controller.epoch
+        assert t["version"] >= 1
+        entries = t["deployments"]["app"]["dep"]["entries"]
+        assert len(entries) == 2
+        for e in entries:
+            assert e["state"] == "HEALTHY"
+            assert "replica_id" in e
+
+    async def test_version_stable_without_changes(self, controller):
+        pub = controller.router_publisher
+        v1 = pub.table()["version"]
+        v2 = pub.table()["version"]
+        assert v1 == v2, "refresh without content change must not churn"
+
+    async def test_diff_carries_only_changes(self, controller):
+        pub = controller.router_publisher
+        v1 = pub.table()["version"]
+        await _deploy(controller, app_id="app2", dep="dep2")
+        diff = pub.table(since_version=v1)
+        assert diff["full"] is False
+        assert "app2" in diff["deployments"]
+        assert "app" not in diff["deployments"], (
+            "unchanged deployment must not ride the diff"
+        )
+
+    async def test_undeploy_rides_diff_as_removal(self, controller):
+        pub = controller.router_publisher
+        await _deploy(controller, app_id="app2", dep="dep2")
+        v = pub.table()["version"]
+        await controller.undeploy("app2")
+        diff = pub.table(since_version=v)
+        assert ["app2", "dep2"] in diff["removed"]
+
+    async def test_sync_report_lands_in_app_status(self, controller):
+        router = StandaloneRouter(
+            "r-status", shared_object_resolver(controller)
+        )
+        router.sync_from(controller)
+        tier = controller.get_app_status("app")["router_tier"]
+        assert tier["table_epoch"] == controller.epoch
+        reported = {r["router_id"] for r in tier["routers"]}
+        assert "r-status" in reported
+        row = next(
+            r for r in tier["routers"] if r["router_id"] == "r-status"
+        )
+        assert row["acked_version"] == tier["table_version"]
+        assert row["staleness_s"] is not None
+
+
+# ---------------------------------------------------------------------------
+# epoch fencing (real journal epochs — the PR 15 fixture idiom)
+# ---------------------------------------------------------------------------
+
+
+class TestEpochFencing:
+    async def test_stale_epoch_push_rejected_typed(self, tmp_path):
+        """Two controller generations against ONE journal directory:
+        the router adopts gen-2's table, then gen-1 (the wedged-then-
+        revived old controller) pushes — rejected typed, view kept."""
+        control = str(tmp_path / "control")
+        old = ServeController(
+            ClusterState(), health_check_period=3600, control_dir=control
+        )
+        await _deploy(old)
+        assert old.epoch == 1
+        new = ServeController(
+            ClusterState(), health_check_period=3600, control_dir=control
+        )
+        await _deploy(new)
+        assert new.epoch == 2
+
+        router = StandaloneRouter("r-fence", shared_object_resolver(new))
+        router.sync_from(new)
+        held = (router.table_epoch, router.table_version)
+        assert held[0] == 2
+
+        with pytest.raises(StaleTableError) as exc:
+            router.apply_table(old.router_publisher.table())
+        assert exc.value.seen_epoch == 2
+        assert exc.value.got_epoch == 1
+        # typed as the NON-retryable epoch-fencing class: re-pushing a
+        # stale table can never succeed
+        assert isinstance(exc.value, StaleEpochError)
+        assert not isinstance(exc.value, RetryableTransportError)
+        # the newer view is untouched, and the router still routes
+        assert (router.table_epoch, router.table_version) == held
+        r = await router.get_handle("app", "dep").call("work", 2, 3)
+        assert r == {"sum": 5}
+        await old.stop()
+        await new.stop()
+
+    async def test_stale_version_same_epoch_rejected(self, controller):
+        router = StandaloneRouter(
+            "r-ver", shared_object_resolver(controller)
+        )
+        stale = controller.router_publisher.table()
+        await _deploy(controller, app_id="app2", dep="dep2")
+        router.sync_from(controller)
+        held_version = router.table_version
+        assert held_version > stale["version"]
+        with pytest.raises(StaleTableError):
+            router.apply_table(stale)
+        assert router.table_version == held_version
+
+    async def test_duplicate_push_is_noop_but_confirms_freshness(
+        self, controller
+    ):
+        router = StandaloneRouter(
+            "r-dup", shared_object_resolver(controller)
+        )
+        router.sync_from(controller)
+        await asyncio.sleep(0.05)
+        aged = router.table_staleness_s
+        assert aged >= 0.05
+        out = router.sync_from(controller)
+        assert out["applied"] is False
+        assert out["reason"] == "duplicate"
+        # a live publisher confirming "nothing changed" RESETS the
+        # staleness clock — a quiet fleet is fresh, not stale
+        assert router.table_staleness_s < aged
+
+    async def test_diff_cannot_cross_epochs(self, tmp_path):
+        control = str(tmp_path / "control")
+        old = ServeController(
+            ClusterState(), health_check_period=3600, control_dir=control
+        )
+        await _deploy(old)
+        router = StandaloneRouter("r-gen", shared_object_resolver(old))
+        router.sync_from(old)
+
+        new = ServeController(
+            ClusterState(), health_check_period=3600, control_dir=control
+        )
+        await _deploy(new)
+        diff = new.router_publisher.table(since_version=1)
+        assert diff["full"] is False
+        with pytest.raises(ValueError, match="cannot cross"):
+            router.apply_table(diff)
+        # a FULL table from the new generation applies cleanly
+        router.apply_table(new.router_publisher.table())
+        assert router.table_epoch == 2
+        await old.stop()
+        await new.stop()
+
+    async def test_last_good_serving_through_controller_restart(
+        self, tmp_path
+    ):
+        """The availability contract: the controller dies, sync fails,
+        the router keeps routing its last-good table (staleness grows);
+        the restarted generation's full table is adopted on first
+        sync."""
+        control = str(tmp_path / "control")
+        old = ServeController(
+            ClusterState(), health_check_period=3600, control_dir=control
+        )
+        await _deploy(old)
+        router = StandaloneRouter(
+            "r-crash", shared_object_resolver(lambda: old)
+        )
+        router.sync_from(old)
+
+        # "crash": the publisher is unreachable — sync raises, the
+        # router's view (and the live replica objects) survive
+        class _Dead:
+            def __getattr__(self, name):
+                raise ConnectionError("controller down")
+
+        with pytest.raises(Exception):
+            router.sync_from(_Dead())
+        r = await router.get_handle("app", "dep").call("work", 20, 22)
+        assert r == {"sum": 42}
+
+        new = ServeController(
+            ClusterState(), health_check_period=3600, control_dir=control
+        )
+        await _deploy(new)
+        assert new.epoch == old.epoch + 1
+        router._resolver = shared_object_resolver(new)
+        router.sync_from(new)
+        assert router.table_epoch == new.epoch
+        r = await router.get_handle("app", "dep").call("work", 1, 1)
+        assert r == {"sum": 2}
+        await old.stop()
+        await new.stop()
+
+
+# ---------------------------------------------------------------------------
+# the standalone request path
+# ---------------------------------------------------------------------------
+
+
+class TestStandaloneRouting:
+    async def test_routes_after_sync(self, controller):
+        router = StandaloneRouter(
+            "r-route", shared_object_resolver(controller)
+        )
+        router.sync_from(controller)
+        r = await router.get_handle("app", "dep").call("work", 3, 4)
+        assert r == {"sum": 7}
+
+    async def test_unsynced_router_has_no_apps(self, controller):
+        router = StandaloneRouter(
+            "r-empty", shared_object_resolver(controller)
+        )
+        with pytest.raises(KeyError):
+            router.get_handle("app", "dep")
+
+    async def test_kill_rejects_new_requests_retryable(self, controller):
+        router = StandaloneRouter(
+            "r-kill", shared_object_resolver(controller)
+        )
+        router.sync_from(controller)
+        router.kill()
+        with pytest.raises(RouterClosedError) as exc:
+            await router.get_handle("app", "dep").call("work", 1, 2)
+        # retryable BY DESIGN: the client's typed-retry machinery fails
+        # the request over to a sibling router
+        assert isinstance(exc.value, RetryableTransportError)
+
+    async def test_inflight_cap_sheds_typed(self):
+        c = ServeController(ClusterState(), health_check_period=3600)
+        await _deploy(c, factory=_Slow, n=1)
+        router = StandaloneRouter(
+            "r-cap", shared_object_resolver(c), max_inflight=1
+        )
+        router.sync_from(c)
+        handle = router.get_handle("app", "dep")
+        first = asyncio.ensure_future(handle.call("work", 1, 2))
+        await asyncio.sleep(0.05)
+        with pytest.raises(RouterSaturatedError) as exc:
+            await handle.call("work", 3, 4)
+        # saturated is ADMISSION backpressure, not a transport fault —
+        # never failed over (every sibling shares the replica pool)
+        assert isinstance(exc.value, AdmissionRejectedError)
+        assert exc.value.reason == "router_saturated"
+        assert await first == {"sum": 3}
+        # the gate drained: the next request admits normally
+        assert await handle.call("work", 5, 6) == {"sum": 11}
+        await c.stop()
+
+    async def test_scheduler_attaches_from_table(self):
+        c = ServeController(ClusterState(), health_check_period=3600)
+        await _deploy(
+            c, n=2,
+            scheduling=SchedulingConfig(max_batch=4, max_wait_ms=1.0),
+        )
+        router = StandaloneRouter("r-sched", shared_object_resolver(c))
+        router.sync_from(c)
+        assert ("app", "dep") in router._schedulers
+        r = await router.get_handle("app", "dep").call(
+            "work", 1, 2,
+            options=RequestOptions(priority="interactive"),
+        )
+        assert r == {"sum": 3}
+        router.kill()
+        assert not router._schedulers, "kill() detaches schedulers"
+        await c.stop()
+
+    async def test_metrics_surface_epoch_and_staleness(self, controller):
+        router = StandaloneRouter(
+            "r-metrics", shared_object_resolver(controller)
+        )
+        router.sync_from(controller)
+        text = metrics.render_prometheus()
+        assert (
+            f'router_table_epoch{{router="r-metrics"}} '
+            f"{controller.epoch}" in text
+        )
+        assert 'router_table_staleness_seconds{router="r-metrics"}' in text
+        assert 'router_inflight_requests{router="r-metrics"} 0' in text
+
+
+# ---------------------------------------------------------------------------
+# the remote resolver (a router in its own process)
+# ---------------------------------------------------------------------------
+
+
+class TestRemoteResolver:
+    def _table(self, controller):
+        return controller.router_publisher.table()
+
+    async def test_routes_over_fake_transport(self, controller):
+        calls = []
+
+        async def call_host(service_id, verb, *args, **kwargs):
+            calls.append((service_id, verb, args))
+            rid, method, call_args, _kw = args[0], args[1], args[2], args[3]
+            assert method == "work"
+            return {"sum": call_args[0] + call_args[1]}
+
+        # dress the published entries as host-bound (the publisher
+        # passes through host_service_id=None for local replicas)
+        table = self._table(controller)
+        for e in table["deployments"]["app"]["dep"]["entries"]:
+            e["host_id"] = "h1"
+            e["host_service_id"] = "svc-h1"
+        router = StandaloneRouter(
+            "r-remote", remote_replica_resolver(call_host)
+        )
+        router.apply_table(table)
+        r = await router.get_handle("app", "dep").call("work", 5, 6)
+        assert r == {"sum": 11}
+        assert calls[0][0] == "svc-h1"
+        assert calls[0][1] == "replica_call"
+
+    async def test_states_follow_table_and_pool_prunes(self, controller):
+        async def call_host(*a, **k):
+            return {}
+
+        table = self._table(controller)
+        entries = table["deployments"]["app"]["dep"]["entries"]
+        for e in entries:
+            e["host_id"] = "h1"
+            e["host_service_id"] = "svc-h1"
+        router = StandaloneRouter(
+            "r-own", remote_replica_resolver(call_host)
+        )
+        router.apply_table(table)
+        pool = router.apps["app"].replicas["dep"]
+        assert [r.state for r in pool] == [ReplicaState.HEALTHY] * 2
+        assert {r.replica_id for r in pool} == {
+            e["replica_id"] for e in entries
+        }
+
+        # next generation of the table drops one replica and marks the
+        # other DRAINING — the router's owned pool follows
+        survivor = dict(entries[0], state="DRAINING")
+        table2 = dict(table, version=table["version"] + 1)
+        table2["deployments"] = {"app": {"dep": {
+            **table["deployments"]["app"]["dep"], "entries": [survivor],
+        }}}
+        router.apply_table(table2)
+        pool = router.apps["app"].replicas["dep"]
+        assert len(pool) == 1
+        assert pool[0].state is ReplicaState.DRAINING
+
+    async def test_local_breaker_verdict_vetoes_table_health(
+        self, controller
+    ):
+        """The router saw the transport failures FIRST-HAND; a table
+        still claiming HEALTHY (the controller's view lags a health
+        tick) must not reopen the breaker for breaker_hold_s."""
+        async def call_host(*a, **k):
+            return {}
+
+        table = self._table(controller)
+        entries = table["deployments"]["app"]["dep"]["entries"]
+        for e in entries:
+            e["host_id"] = "h1"
+            e["host_service_id"] = "svc-h1"
+        router = StandaloneRouter(
+            "r-veto", remote_replica_resolver(call_host),
+            breaker_threshold=3,
+        )
+        router.apply_table(table)
+        victim = router.apps["app"].replicas["dep"][0]
+        for _ in range(3):
+            router._breaker_failure(victim, ConnectionError("boom"))
+        assert victim.state is ReplicaState.UNHEALTHY
+
+        repush = dict(table, version=table["version"] + 1)
+        router.apply_table(repush)
+        assert victim.state is ReplicaState.UNHEALTHY, (
+            "table health must not outrank a fresh local breaker verdict"
+        )
+        # once the hold expires the table's view wins again
+        router.breaker_hold_s = 0.0
+        router.apply_table(dict(table, version=table["version"] + 2))
+        assert victim.state is ReplicaState.HEALTHY
+
+
+# ---------------------------------------------------------------------------
+# shared-contract pins (the bugfix sweep)
+# ---------------------------------------------------------------------------
+
+
+class TestSharedContract:
+    ROUTER_METHODS = (
+        "get_handle",
+        "_pick_replica",
+        "_pick_replica_wait",
+        "_breaker_failure",
+        "_breaker_success",
+        "_note_attempt_latency",
+        "_apply_probation_transitions",
+        "hedge_delay_s",
+    )
+
+    def test_router_half_lives_only_in_routercore(self):
+        """The seam: ServeController and StandaloneRouter both ROUTE
+        through the single RouterCore implementation — neither may
+        shadow it (a shadow is exactly the drift the sweep forbids)."""
+        for name in self.ROUTER_METHODS:
+            assert name in RouterCore.__dict__, name
+            assert name not in ServeController.__dict__, (
+                f"ServeController shadows RouterCore.{name}"
+            )
+            assert name not in StandaloneRouter.__dict__, (
+                f"StandaloneRouter shadows RouterCore.{name}"
+            )
+        assert issubclass(ServeController, RouterCore)
+        assert issubclass(StandaloneRouter, RouterCore)
+
+    def test_exactly_one_breaker_exemption_and_scorer_argmin(self):
+        """Source-level pin: ONE definition of the caller-timeout
+        breaker exemption (errors.is_caller_timeout) and ONE
+        _best_replica scorer argmin in the whole tree."""
+        defs = {"def is_caller_timeout": [], "def _best_replica": []}
+        for path in SRC_ROOT.rglob("*.py"):
+            text = path.read_text()
+            for needle, hits in defs.items():
+                hits.extend(
+                    (path, m.start())
+                    for m in re.finditer(re.escape(needle), text)
+                )
+        for needle, hits in defs.items():
+            assert len(hits) == 1, (
+                f"{needle!r} defined {len(hits)}x: "
+                f"{[str(p) for p, _ in hits]}"
+            )
+
+    def test_handle_is_the_router_module_class(self):
+        """controller.get_handle returns the ONE DeploymentHandle —
+        the class that moved to router.py; controller.py re-imports it
+        (bit-compatible path, single implementation)."""
+        from bioengine_tpu.serving import controller as controller_mod
+
+        assert controller_mod.DeploymentHandle is DeploymentHandle
+        assert DeploymentHandle.__module__ == "bioengine_tpu.serving.router"
