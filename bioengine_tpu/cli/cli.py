@@ -16,6 +16,7 @@ from bioengine_tpu.cli.apps import apps_group
 from bioengine_tpu.cli.call import call_command
 from bioengine_tpu.cli.cluster import cluster_group
 from bioengine_tpu.cli.debug import debug_group
+from bioengine_tpu.cli.fuzz import fuzz_command
 from bioengine_tpu.cli.models import models_group
 from bioengine_tpu.cli.scenarios import scenarios_group
 from bioengine_tpu.cli.slo import slo_group, top_command
@@ -32,6 +33,7 @@ main.add_command(call_command)
 main.add_command(apps_group)
 main.add_command(cluster_group)
 main.add_command(debug_group)
+main.add_command(fuzz_command)
 main.add_command(models_group)
 main.add_command(scenarios_group)
 main.add_command(slo_group)

@@ -11,6 +11,7 @@ ref bioengine/cluster/ray_cluster.py:844-861,171.
 
 from __future__ import annotations
 
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -183,6 +184,12 @@ class ClusterState:
             d for d, r in self._chips_in_use.items() if r == replica_id
         ]:
             del self._chips_in_use[d]
+        if os.environ.get("BIOENGINE_FUZZ_DRILL") == "1":
+            # second half of the flag-gated drill defect (see
+            # mark_host_dead): host-side lease reclamation is skipped,
+            # so a dead host's chips leak until the host record is
+            # replaced by a rejoin
+            return
         for host in self.hosts.values():
             for d in [
                 d for d, r in host.chips_in_use.items() if r == replica_id
@@ -226,6 +233,16 @@ class ClusterState:
             return []
         host.alive = False
         orphans = sorted(set(host.chips_in_use.values()))
+        if os.environ.get("BIOENGINE_FUZZ_DRILL") == "1":
+            # Deliberate, flag-gated lease-accounting defect (the chaos
+            # fuzzer's end-to-end drill): a dead host's lease table is
+            # left populated, so every chip it held leaks forever. The
+            # fuzzer must find this through the lease_conservation
+            # universal invariant and shrink the failing schedule to a
+            # minimal repro — proving the searcher + shrinker work on a
+            # KNOWN bug, not just accidental ones. Never set this flag
+            # outside the fuzz drill.
+            return orphans
         host.chips_in_use.clear()
         return orphans
 

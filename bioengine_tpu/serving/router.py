@@ -998,9 +998,25 @@ class RouterCore:
             try:
                 return self._pick_replica(app_id, deployment, avoid=avoid)
             except NoHealthyReplicasError:
+                gate = self._router_gate
+                if gate is not None and gate.closed:
+                    # a closed router will never (re-)place a replica —
+                    # waiting out the deadline here only burns the
+                    # caller's retry budget; refuse typed NOW so the
+                    # client fails over to a sibling or a healed plane
+                    raise RouterClosedError(
+                        f"router {gate.router_id} is closed to new "
+                        "requests"
+                    ) from None
                 remaining = wait_until - time.monotonic()
                 if remaining <= 0:
                     raise
+                # a waiter with nothing routable is the same signal a
+                # breaker trip is: capacity may be back (a rejoined
+                # host) with placement still sitting out the health
+                # period — ring the health loop so the top-up runs NOW,
+                # not up to health_check_period later
+                self._wake_health.set()
                 self._replicas_changed.clear()
                 try:
                     # woken early when a replica is (re-)placed

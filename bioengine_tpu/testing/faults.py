@@ -62,6 +62,7 @@ Registered fault points:
 from __future__ import annotations
 
 import asyncio
+import copy
 import os
 import random
 from dataclasses import dataclass, field
@@ -72,10 +73,19 @@ ACTIVE = False
 _specs: dict[str, "FaultSpec"] = {}
 _hits: dict[str, int] = {}
 
+_ACTIONS = ("raise", "delay", "drop", "slow_ramp")
+
 
 class FaultInjected(ConnectionError):
     """Raised by a triggered fault point. Subclasses ConnectionError so
     the request path treats it as a transport failure."""
+
+
+class FaultSpecError(ValueError):
+    """A malformed fault spec (``BIOENGINE_FAULTS`` entry or
+    :func:`configure` arguments). Raised at parse/arm time so a typo'd
+    chaos configuration fails the run loudly instead of silently arming
+    nothing."""
 
 
 @dataclass
@@ -122,10 +132,20 @@ def configure(
     """Arm a fault point. Resets the point's hit counter. ``point`` may
     carry an inline ``@scope`` suffix (the env-var syntax)."""
     global ACTIVE
-    if action not in ("raise", "delay", "drop", "slow_ramp"):
-        raise ValueError(f"unknown fault action '{action}'")
+    if action not in _ACTIONS:
+        raise FaultSpecError(
+            f"unknown fault action '{action}' "
+            f"(known: {', '.join(_ACTIONS)})"
+        )
     if scope is None and "@" in point:
         point, _, scope = point.partition("@")
+    if not point:
+        raise FaultSpecError("fault spec has an empty point name")
+    if nth < 1 or count < 1:
+        raise FaultSpecError(
+            f"fault '{point}': nth and count are 1-based positives "
+            f"(got nth={nth}, count={count})"
+        )
     key = _key(point, scope)
     _specs[key] = FaultSpec(
         point, action, nth, count, delay_s,
@@ -154,6 +174,43 @@ def clear(point: Optional[str] = None) -> None:
             _specs.pop(key, None)
             _hits.pop(key, None)
     ACTIVE = bool(_specs)
+
+
+def clear_all() -> int:
+    """Disarm EVERY fault point and zero every hit counter; returns how
+    many specs were armed. The fuzz loop calls this between iterations
+    so one schedule's leftover armed points (or half-consumed hit
+    windows) can never bleed into the next run."""
+    global ACTIVE
+    n = len(_specs)
+    _specs.clear()
+    _hits.clear()
+    ACTIVE = False
+    return n
+
+
+def snapshot() -> dict:
+    """Capture the whole fault-layer state — armed specs (including
+    each slow_ramp spec's consumed RNG state), hit counters, and the
+    ACTIVE flag — so a nested harness (the fuzzer, a test) can run with
+    its own faults and :func:`restore` the ambient state afterwards."""
+    return {
+        "specs": copy.deepcopy(_specs),
+        "hits": dict(_hits),
+        "active": ACTIVE,
+    }
+
+
+def restore(snap: dict) -> None:
+    """Restore a :func:`snapshot` exactly. The module dicts are mutated
+    in place (never rebound) so call sites holding references keep
+    seeing the live state."""
+    global ACTIVE
+    _specs.clear()
+    _specs.update(copy.deepcopy(snap["specs"]))
+    _hits.clear()
+    _hits.update(snap["hits"])
+    ACTIVE = bool(snap["active"])
 
 
 def hits(point: str, scope: Optional[str] = None) -> int:
@@ -231,21 +288,40 @@ async def hit(
 
 
 def load_env(env_value: Optional[str] = None) -> None:
-    """Parse ``BIOENGINE_FAULTS`` (subprocess configuration path)."""
+    """Parse ``BIOENGINE_FAULTS`` (subprocess configuration path).
+    Malformed entries raise :class:`FaultSpecError` naming the entry —
+    a chaos run with a typo'd spec must fail at parse time, not run
+    clean with nothing armed."""
     raw = (
         env_value
         if env_value is not None
         else os.environ.get("BIOENGINE_FAULTS", "")
     )
     for entry in filter(None, (e.strip() for e in raw.split(";"))):
-        point, _, rest = entry.partition("=")
+        point, eq, rest = entry.partition("=")
+        if not eq or not point.strip():
+            raise FaultSpecError(
+                f"malformed fault spec '{entry}': expected "
+                "'point[@scope]=action[:nth[:count[:delay_s"
+                "[:seed[:ramp_hits]]]]]'"
+            )
         parts = rest.split(":")
+        if len(parts) > 6:
+            raise FaultSpecError(
+                f"malformed fault spec '{entry}': too many ':' fields "
+                f"({len(parts)}, max 6)"
+            )
         action = parts[0]
-        nth = int(parts[1]) if len(parts) > 1 else 1
-        count = int(parts[2]) if len(parts) > 2 else 1 << 30
-        delay_s = float(parts[3]) if len(parts) > 3 else 0.05
-        seed = int(parts[4]) if len(parts) > 4 else 0
-        ramp_hits = int(parts[5]) if len(parts) > 5 else 16
+        try:
+            nth = int(parts[1]) if len(parts) > 1 else 1
+            count = int(parts[2]) if len(parts) > 2 else 1 << 30
+            delay_s = float(parts[3]) if len(parts) > 3 else 0.05
+            seed = int(parts[4]) if len(parts) > 4 else 0
+            ramp_hits = int(parts[5]) if len(parts) > 5 else 16
+        except ValueError as e:
+            raise FaultSpecError(
+                f"malformed fault spec '{entry}': {e}"
+            ) from None
         configure(
             point.strip(), action, nth=nth, count=count, delay_s=delay_s,
             seed=seed, ramp_hits=ramp_hits,

@@ -110,16 +110,22 @@ class Stream:
 
 @dataclass(frozen=True)
 class FaultEvent:
-    """One scripted incident step, pinned to a tick."""
+    """One scripted incident step, pinned to a tick. This is also the
+    chaos fuzzer's schedule-event vocabulary — every field must stay
+    JSON-serializable (fuzz repro artifacts are ``asdict`` of these)."""
 
     at_tick: int
     # kill_host | respawn_host | slow_ramp | blip | clear_faults |
-    # kill_controller | restart_controller | stale_verb | kill_router
+    # kill_controller | restart_controller | stale_verb | kill_router |
+    # traffic_burst (extra seeded arrivals at this tick) |
+    # clock_skew (shift every host's reported clock by skew_s)
     action: str
     host: Optional[str] = None
     delay_s: float = 0.2         # slow_ramp target delay
     ramp_hits: int = 12          # slow_ramp hits to reach full delay
     point: str = "host.replica_call"
+    burst: int = 0               # traffic_burst: extra arrivals
+    skew_s: float = 0.0          # clock_skew: seconds of host-clock shift
 
 
 @dataclass(frozen=True)
@@ -185,6 +191,12 @@ class Scenario:
     # the published routing table carries a fleet-scale host membership
     # block (replicas stay local — the routing work is what's under test)
     sim_hosts: int = 0
+    # wall-clock watchdog: a livelocked run fails typed (the
+    # watchdog_timeout universal invariant goes red with a flight dump)
+    # instead of hanging the suite. None derives a generous budget from
+    # ticks/deadline; the fuzzer relies on this to survive pathological
+    # schedules. Scaled by BIOENGINE_SCENARIO_SCALE like everything else.
+    watchdog_s: Optional[float] = None
 
 
 # ---------------------------------------------------------------------------
@@ -313,6 +325,9 @@ class _Plane:
         # SIGKILL'd controllers, kept so stale_verb can replay a
         # lower-epoch verb from them (the split-brain probe)
         self.old_controllers: list[Any] = []
+        # every controller incarnation's fencing epoch, in order — the
+        # epoch_monotonic universal invariant reads this
+        self.epoch_history: list[Any] = []
         # scale-out router tier (scenario.n_routers > 0)
         self.routers: list[Any] = []
         self.killed_routers: list[str] = []
@@ -387,6 +402,7 @@ class _Plane:
             self._register_sim_hosts()
         if s.n_routers > 0:
             self._start_routers()
+        self.epoch_history.append(getattr(self.controller, "epoch", None))
 
     def _register_sim_hosts(self) -> None:
         """Fleet dressing: N synthetic mesh hosts in ClusterState so the
@@ -473,6 +489,11 @@ class _Plane:
         start rejoin backoff) and the controller object is abandoned
         mid-state: no drains, no undeploys, no journal goodbye. The
         journal directory is all that survives."""
+        if self.server is None:
+            # already dead — killing a corpse is a no-op. The fuzzer's
+            # shrinker runs arbitrary subsets of a schedule, so the
+            # substrate must accept unpaired lifecycle verbs.
+            return
         # self.controller keeps pointing at the dead object until the
         # restart lands — exactly what a client with a stale reference
         # sees; its calls fail fast (provider gone) and client_retry
@@ -487,6 +508,18 @@ class _Plane:
         # that: fail queued work typed NOW, drain nothing
         for sched in self.controller._schedulers.values():
             sched.kill()
+        # a SIGKILL'd process refuses new connections instantly — model
+        # that on the abandoned object too: calls through a stale
+        # reference get a typed fast refusal (RouterClosedError) instead
+        # of burning their whole deadline in _pick_replica_wait on
+        # replicas a dead control plane can never re-place (the chaos
+        # fuzzer found exactly that: paired kill/restart still lost
+        # idempotent traffic because one slow failure ate the budget)
+        from bioengine_tpu.serving.router import _RouterGate
+
+        gate = _RouterGate(router_id="controller-sigkilled")
+        gate.closed = True
+        self.controller._router_gate = gate
         logger.info("scenario: controller killed (SIGKILL-equivalent)")
 
     async def restart_controller(self) -> None:
@@ -494,6 +527,11 @@ class _Plane:
         admin token: replays snapshot+journal into RECOVERING, attaches
         the router, and lets the hosts' reconnect loops bring their
         warm-replica inventory back for reconcile."""
+        if self.server is not None:
+            # control plane is up — nothing to restart. An unpaired
+            # restart (a shrinker candidate that dropped the kill)
+            # must not try to double-bind the port.
+            return
         from bioengine_tpu.rpc.server import RpcServer
 
         server = RpcServer(
@@ -508,6 +546,7 @@ class _Plane:
         controller.attach_rpc(server, admin_users=["admin"])
         self.server = server
         self.controller = controller
+        self.epoch_history.append(getattr(controller, "epoch", None))
         logger.info(
             f"scenario: controller restarted (epoch {controller.epoch}, "
             f"phase {controller.phase})"
@@ -571,6 +610,16 @@ class _Plane:
                 self.dead_hosts[ev.host] = host
                 await _kill_host(host)
         elif ev.action == "respawn_host":
+            if self.server is None:
+                # the control plane is down — a real preempted host
+                # would retry its join until a controller answers; the
+                # harness just skips the rejoin (fuzz schedules may
+                # land a respawn inside a controller-dead window)
+                logger.info(
+                    f"scenario: respawn of {ev.host} skipped "
+                    "(controller down)"
+                )
+                return
             old = self.dead_hosts.pop(ev.host, None)
             if old is not None:
                 try:
@@ -611,6 +660,20 @@ class _Plane:
             await self.stale_verb()
         elif ev.action == "kill_router":
             self.kill_router(ev.host)
+        elif ev.action == "traffic_burst":
+            # the burst itself lives in the request PLAN (built from the
+            # fault script before the run, keeping the plan a pure
+            # function of the seed) — nothing to do at apply time
+            pass
+        elif ev.action == "clock_skew":
+            # every host's clock drifts by skew_s relative to the
+            # controller: shift the recorded skew estimate and the
+            # registration timestamps the way a real skewed rejoin
+            # would report them (timeline merge / telemetry attribution
+            # must de-skew; nothing placement-critical keys off these)
+            for host in self.controller.cluster_state.hosts.values():
+                host.clock_skew_s += ev.skew_s
+                host.registered_at -= ev.skew_s
         else:
             raise ValueError(f"unknown fault action '{ev.action}'")
 
@@ -664,6 +727,15 @@ async def run_scenario_async(
     plane = _Plane(s, seed, defenses, scale, workdir)
 
     # ---- deterministic request plan (pure function of seed) ----------------
+    # traffic_burst events inject extra arrivals; they are folded in
+    # HERE, while the plan is built, so the request plan stays a pure
+    # function of (seed, scenario+fault script) and replays exactly
+    burst_by_tick: dict[int, int] = {}
+    for ev in s.fault_script:
+        if ev.action == "traffic_burst":
+            burst_by_tick[ev.at_tick] = (
+                burst_by_tick.get(ev.at_tick, 0) + max(0, ev.burst)
+            )
     plan: list[dict] = []
     for tick in range(s.ticks):
         for stream in s.streams:
@@ -690,6 +762,16 @@ async def run_scenario_async(
                         "b": b,
                     }
                 )
+        for _ in range(burst_by_tick.get(tick, 0)):
+            plan.append(
+                {
+                    "idx": len(plan),
+                    "tick": tick,
+                    "stream": s.streams[0],
+                    "a": rng.randrange(1000),
+                    "b": rng.randrange(1000),
+                }
+            )
 
     outcomes: list[Optional[str]] = [None] * len(plan)
     latencies: list[Optional[float]] = [None] * len(plan)
@@ -751,6 +833,17 @@ async def run_scenario_async(
                     plane.router_failovers += 1
                     if router_offset < n_routers:
                         continue
+                    if (
+                        s.client_retry
+                        and req["stream"].idempotent
+                        and time.monotonic() < budget_until - 0.5 * scale
+                    ):
+                        # no sibling absorbed it (or no router tier):
+                        # the refusal came from a SIGKILL'd control
+                        # plane — re-resolve through whatever controller
+                        # answers next, like any transport failure
+                        await asyncio.sleep(0.05 * scale)
+                        continue
                     outcomes[idx] = "failed:RouterClosedError"
                 except AdmissionRejectedError:
                     outcomes[idx] = "shed"
@@ -777,42 +870,96 @@ async def run_scenario_async(
 
         t_run = time.monotonic()
         tasks: list[asyncio.Task] = []
-        for tick in range(s.ticks):
-            for ev in fault_by_tick.get(tick, ()):
-                await plane.apply(ev, seed)
-            for req in by_tick.get(tick, ()):
-                tasks.append(asyncio.create_task(one(req)))
-            await asyncio.sleep(s.tick_s * scale)
-            queue_samples.append(
-                sum(plane.controller._queue_depth.values())
-                + sum(
-                    sum(r._queue_depth.values()) for r in plane.routers
+
+        async def _drive() -> None:
+            for tick in range(s.ticks):
+                for ev in fault_by_tick.get(tick, ()):
+                    await plane.apply(ev, seed)
+                for req in by_tick.get(tick, ()):
+                    tasks.append(asyncio.create_task(one(req)))
+                await asyncio.sleep(s.tick_s * scale)
+                queue_samples.append(
+                    sum(plane.controller._queue_depth.values())
+                    + sum(
+                        sum(r._queue_depth.values()) for r in plane.routers
+                    )
                 )
-            )
-            if plane.routers and tick % s.router_sync_every == 0:
-                plane.sync_routers()
-            if tick % s.health_every == 0:
+                if plane.routers and tick % s.router_sync_every == 0:
+                    plane.sync_routers()
+                if tick % s.health_every == 0:
+                    await plane.controller.health_tick()
+            # drain: every request finishes (deadlines bound this), then
+            # the plane settles so leak checks see steady state, not
+            # shutdown. The health cadence keeps running while requests
+            # drain — production's background health loop doesn't stop
+            # when the traffic generator does, and a request waiting in
+            # _pick_replica_wait for a re-placed replica would otherwise
+            # starve out its whole deadline against a rejoined host
+            # nobody tops up (found by the chaos fuzzer: kill one host,
+            # blip the other near the last tick)
+            drained = asyncio.Event()
+
+            async def _drain_health() -> None:
+                period = s.health_every * s.tick_s * scale
+                while True:
+                    try:
+                        await asyncio.wait_for(drained.wait(), period)
+                        return
+                    except asyncio.TimeoutError:
+                        await plane.controller.health_tick()
+
+            drain_health = asyncio.create_task(_drain_health())
+            try:
+                await asyncio.gather(*tasks)
+            finally:
+                drained.set()
+                await drain_health
+            for _ in range(3):
                 await plane.controller.health_tick()
-        # drain: every request finishes (deadlines bound this), then the
-        # plane settles so leak checks see steady state, not shutdown
-        await asyncio.gather(*tasks)
-        for _ in range(3):
-            await plane.controller.health_tick()
-            await asyncio.sleep(0.05 * scale)
-        # detached hedge probes (a probation replica is slow by
-        # definition) may still be settling — give the RPC plane a
-        # bounded window to drain before the leak invariants look
-        settle_until = time.monotonic() + 3.0 * scale
-        while time.monotonic() < settle_until:
-            pending = len(plane.server._pending) if plane.server else 0
-            if not pending:
-                break
-            await asyncio.sleep(0.02)
+                await asyncio.sleep(0.05 * scale)
+            # detached hedge probes (a probation replica is slow by
+            # definition) may still be settling — give the RPC plane a
+            # bounded window to drain before the leak invariants look
+            settle_until = time.monotonic() + 3.0 * scale
+            while time.monotonic() < settle_until:
+                pending = len(plane.server._pending) if plane.server else 0
+                if not pending:
+                    break
+                await asyncio.sleep(0.02)
+
+        # wall-clock watchdog: a pathological schedule (livelock, a
+        # drain that never drains) fails TYPED — watchdog_timeout goes
+        # red with a flight dump attached — instead of hanging the
+        # suite. The fuzzer depends on this to survive schedules nobody
+        # would write by hand.
+        watchdog_budget = (
+            s.watchdog_s
+            if s.watchdog_s is not None
+            else s.ticks * s.tick_s + s.deadline_s + 30.0
+        ) * scale
+        watchdog_fired = False
+        try:
+            await asyncio.wait_for(_drive(), timeout=watchdog_budget)
+        except asyncio.TimeoutError:
+            watchdog_fired = True
+            flight.dump(
+                "watchdog_timeout",
+                scenario=s.name,
+                budget_s=round(watchdog_budget, 3),
+            )
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            for i, out in enumerate(outcomes):
+                if out is None:
+                    outcomes[i] = "failed:WatchdogTimeout"
         wall = time.monotonic() - t_run
 
         result = _evaluate(
             s, seed, defenses, plane, plan, outcomes, latencies,
             queue_samples, flight_t0, wall,
+            watchdog_fired=watchdog_fired,
+            watchdog_budget=watchdog_budget,
         )
         return result
     finally:
@@ -846,7 +993,10 @@ def _evaluate(
     queue_samples: list,
     flight_t0: float,
     wall: float,
+    watchdog_fired: bool = False,
+    watchdog_budget: Optional[float] = None,
 ) -> dict:
+    from bioengine_tpu.testing import invariants as universal
     # normalized outcome sequence: strict streams record the real
     # class; best-effort streams (flood) collapse served/shed into
     # "absorbed" (the contract they are held to — see module docstring)
@@ -926,6 +1076,27 @@ def _evaluate(
             "detail": detail,
         }
 
+    # the universal library runs on EVERY scenario, always required —
+    # these are the promises the stack makes regardless of which faults
+    # a schedule composed (and what `bioengine fuzz` hunts violations of)
+    ctx = universal.RunContext(
+        scenario=s,
+        plane=plane,
+        plan=plan,
+        outcomes=outcomes,
+        flight_t0=flight_t0,
+        scale=_scale(),
+        watchdog_fired=watchdog_fired,
+        watchdog_budget_s=watchdog_budget,
+    )
+    for name, (ok, detail) in universal.evaluate_universal(ctx).items():
+        invariants[name] = {
+            "ok": bool(ok),
+            "required": True,
+            "universal": True,
+            "detail": detail,
+        }
+
     counts: dict[str, int] = {}
     for out in seq:
         counts[out] = counts.get(out, 0) + 1
@@ -976,6 +1147,12 @@ def _evaluate(
         ),
         "hedges": len(hedge_events),
         "routers": routers_section,
+        # the distinct flight-event types this run produced — one third
+        # of the fuzzer's coverage signature (which code paths fired,
+        # not just how requests ended)
+        "flight_event_types": sorted(
+            {e["type"] for e in flight.get_events(since=flight_t0)}
+        ),
     }
 
 
@@ -1001,65 +1178,18 @@ def _inv_zero_failed(plan, outcomes) -> tuple[bool, str]:
 
 
 def _inv_chips(plane: _Plane) -> tuple[bool, str]:
-    state = plane.controller.cluster_state
-    problems = []
-    live_replicas = {
-        r.replica_id: r
-        for app in plane.controller.apps.values()
-        for reps in app.replicas.values()
-        for r in reps
-    }
-    for host in state.hosts.values():
-        if not host.alive and host.chips_in_use:
-            problems.append(f"dead host {host.host_id} leaks leases")
-        for chip, rid in host.chips_in_use.items():
-            if rid not in live_replicas:
-                problems.append(
-                    f"chip {chip} on {host.host_id} leased by dead {rid}"
-                )
-    for rid, r in live_replicas.items():
-        host_id = getattr(r, "host_id", None)
-        if host_id is None or not r.device_ids:
-            continue
-        host = state.hosts.get(host_id)
-        held = (
-            [c for c, owner in host.chips_in_use.items() if owner == rid]
-            if host
-            else []
-        )
-        if host is None or sorted(held) != sorted(r.device_ids):
-            problems.append(
-                f"{rid} lease mismatch on {host_id}: "
-                f"{held} vs {r.device_ids}"
-            )
+    # delegated to the universal library (testing/invariants.py) — the
+    # per-scenario name stays for scenario definitions and old artifacts
+    from bioengine_tpu.testing.invariants import lease_problems
+
+    problems = lease_problems(plane.controller)
     return not problems, "; ".join(problems) or "exact"
 
 
 def _inv_no_stuck(plane: _Plane) -> tuple[bool, str]:
-    from bioengine_tpu.utils import tasks as task_registry
+    from bioengine_tpu.testing.invariants import liveness_problems
 
-    problems = []
-    if plane.server is not None and plane.server._pending:
-        problems.append(f"server pending: {len(plane.server._pending)}")
-    for host_id, host in plane.hosts.items():
-        conn = host.connection
-        if conn is not None and conn._pending:
-            problems.append(f"{host_id} pending: {len(conn._pending)}")
-    sched_owners = [("controller", plane.controller)] + [
-        (r.router_id, r) for r in plane.routers
-    ]
-    for owner, core in sched_owners:
-        for key, sched in core._schedulers.items():
-            if sched.waiting or sched._open or sched._inflight:
-                problems.append(
-                    f"{owner} scheduler {key}: waiting={sched.waiting} "
-                    f"open={len(sched._open)} inflight={len(sched._inflight)}"
-                )
-    lingering = [
-        t for t in task_registry._BACKGROUND_TASKS if not t.done()
-    ]
-    if len(lingering) > 16:
-        problems.append(f"{len(lingering)} lingering supervised tasks")
+    problems = liveness_problems(plane)
     return not problems, "; ".join(problems) or "drained"
 
 

@@ -1,0 +1,68 @@
+#!/usr/bin/env bash
+# Chaos-fuzz gate (the coverage-guided fault-schedule fuzzer,
+# bioengine_tpu/testing/fuzz.py) — three time-boxed legs:
+#
+#   1. corpus replay   every checked-in repro in tests/fuzz_corpus
+#                      must reproduce its recorded red set and replay
+#                      bit-deterministically (two runs, identical
+#                      outcome signatures)
+#   2. the drill       BIOENGINE_FUZZ_DRILL=1 arms a deliberate
+#                      lease-accounting defect (cluster/state.py); the
+#                      search must FIND it via the lease_conservation
+#                      universal invariant and shrink it to <= 3
+#                      events inside the budget — the end-to-end proof
+#                      on a KNOWN bug
+#   3. clean search    a short budget against the honest engine must
+#                      find NOTHING (every universal invariant holds
+#                      across generated schedules — the zero-false-
+#                      positive bar)
+#
+# Knobs:
+#   BIOENGINE_FUZZ_BUDGET_S  wall-clock budget per search leg (default 120)
+#   BIOENGINE_FUZZ_SEED      search seed (default 1)
+set -euo pipefail
+
+cd "$(dirname "$0")/../.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+BUDGET="${BIOENGINE_FUZZ_BUDGET_S:-120}"
+SEED="${BIOENGINE_FUZZ_SEED:-1}"
+# hard wall per CLI invocation: the budget plus room for the baseline
+# run, shrinking, and artifact replay
+BOX=$((BUDGET + 120))
+
+echo "== fuzz gate (budget ${BUDGET}s/leg, seed ${SEED}) =="
+
+echo "-- corpus replay (deterministic regression repros)"
+timeout -k 10 "$BOX" python -m bioengine_tpu.cli fuzz \
+    --corpus tests/fuzz_corpus
+
+echo "-- drill: search must find + shrink the armed lease leak"
+out="$(mktemp -d)"
+timeout -k 10 "$BOX" python -m bioengine_tpu.cli fuzz \
+    --drill --seed "$SEED" --budget-s "$BUDGET" --out "$out" > "$out/report.json"
+python - "$out/report.json" <<'EOF'
+import json
+import sys
+
+with open(sys.argv[1]) as f:
+    d = json.load(f)
+arts = d["artifacts"]
+assert arts, f"drill found nothing: {d['stats']}"
+a = arts[0]
+assert a["expect"]["red"] == ["lease_conservation"], a["expect"]
+assert len(a["events"]) <= 3, (
+    f"shrinker left {len(a['events'])} events (want <= 3)"
+)
+print(
+    f"drill OK: found + shrunk to {len(a['events'])} event(s) in "
+    f"{d['stats']['runs']} runs / {d['stats']['elapsed_s']}s"
+)
+EOF
+
+echo "-- clean search: the honest engine must survive the same budget"
+timeout -k 10 "$BOX" python -m bioengine_tpu.cli fuzz \
+    --seed "$SEED" --budget-s "$BUDGET" --keep-going
+
+echo "fuzz gate OK"

@@ -1,0 +1,407 @@
+"""Chaos fuzzer: fault-layer hygiene, the universal invariant library,
+the watchdog, the shrinker, artifact replay determinism, and the
+end-to-end lease-leak drill.
+
+Tier-1 proves the loop on a KNOWN bug: the trimmed drill hands the
+shrinker a multi-event schedule over the armed lease-accounting defect
+(BIOENGINE_FUZZ_DRILL=1) and requires a locally-minimal repro; the
+checked-in corpus artifact must replay bit-deterministically. The full
+budget-boxed search drill lives in scripts/workflows/fuzz.sh (CI's
+fuzz job) and in the slow marker here.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from bioengine_tpu.testing import faults
+from bioengine_tpu.testing import fuzz as fuzzer
+from bioengine_tpu.testing.scenarios import FaultEvent, outcome_signature
+
+pytestmark = [pytest.mark.integration, pytest.mark.anyio]
+
+CORPUS_DIR = Path(__file__).parent / "fuzz_corpus"
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# satellite: fault-layer hygiene (snapshot/restore, clear_all, typed parse)
+# ---------------------------------------------------------------------------
+
+
+class TestFaultHygiene:
+    def test_clear_all_disarms_everything_and_reports_count(self):
+        faults.configure("p1", "raise")
+        faults.configure("p2", "delay", delay_s=0.01)
+        faults.configure("p2", "drop", scope="h1")
+        assert faults.ACTIVE
+        assert faults.clear_all() == 3
+        assert not faults.ACTIVE
+        assert faults._specs == {} and faults._hits == {}
+        assert faults.clear_all() == 0  # idempotent
+
+    async def test_snapshot_restore_roundtrips_exactly(self):
+        """Armed specs, CONSUMED hit counters, and the ACTIVE flag all
+        survive a snapshot/clobber/restore cycle — the fuzz loop's
+        between-iterations contract."""
+        faults.configure("pt", "raise", nth=3)
+        await faults.hit("pt")  # consume one pass (below the window)
+        snap = faults.snapshot()
+
+        faults.clear_all()
+        faults.configure("other", "raise")
+        faults.restore(snap)
+
+        assert set(faults._specs) == {"pt"}
+        assert faults.ACTIVE
+        assert faults.hits("pt") == 1
+        # the restored window continues where it left off: pass 2 is
+        # quiet, pass 3 triggers
+        await faults.hit("pt")
+        with pytest.raises(faults.FaultInjected):
+            await faults.hit("pt")
+
+    def test_snapshot_is_isolated_from_later_mutation(self):
+        faults.configure("pt", "raise")
+        snap = faults.snapshot()
+        faults.configure("pt", "delay", delay_s=9.9)
+        assert snap["specs"]["pt"].action == "raise"
+
+    def test_restore_of_inactive_snapshot_deactivates(self):
+        snap = faults.snapshot()  # empty state
+        faults.configure("pt", "raise")
+        faults.restore(snap)
+        assert not faults.ACTIVE and faults._specs == {}
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "no_equals_sign",
+            "=raise",                      # empty point
+            "p=explode",                   # unknown action
+            "p=raise:zero",                # non-numeric nth
+            "p=raise:1:2:x",               # non-numeric delay
+            "p=raise:1:2:0.1:1:16:extra",  # too many fields
+        ],
+    )
+    def test_malformed_env_specs_raise_typed(self, bad):
+        with pytest.raises(faults.FaultSpecError):
+            faults.load_env(bad)
+
+    def test_configure_rejects_bad_windows_and_actions(self):
+        with pytest.raises(faults.FaultSpecError):
+            faults.configure("p", "raise", nth=0)
+        with pytest.raises(faults.FaultSpecError):
+            faults.configure("p", "raise", count=0)
+        with pytest.raises(faults.FaultSpecError):
+            faults.configure("p", "frobnicate")
+        with pytest.raises(faults.FaultSpecError):
+            faults.configure("", "raise")
+
+    def test_well_formed_env_still_parses(self):
+        faults.load_env("p@h1=slow_ramp:1:1000:0.2:42:20")
+        spec = faults._specs["p@h1"]
+        assert spec.scope == "h1" and spec.seed == 42
+        assert spec.ramp_hits == 20
+
+
+# ---------------------------------------------------------------------------
+# schedule generation + repair stay inside the fair envelope
+# ---------------------------------------------------------------------------
+
+
+class TestGenerateAndRepair:
+    def test_generated_schedules_are_fair_and_deterministic(self):
+        import random
+
+        for seed in range(30):
+            a = fuzzer.generate("small_multihost", random.Random(seed))
+            b = fuzzer.generate("small_multihost", random.Random(seed))
+            assert a == b, "generator must be a pure function of seed"
+            assert fuzzer.is_fair("small_multihost", a)
+
+    def test_repair_pairs_controller_kill_with_restart(self):
+        import random
+
+        events = [FaultEvent(at_tick=10, action="kill_controller")]
+        repaired = fuzzer.repair(
+            "small_multihost", events, random.Random(0)
+        )
+        actions = [e.action for e in repaired]
+        assert actions == ["kill_controller", "restart_controller"]
+        assert repaired[1].at_tick > repaired[0].at_tick
+
+    def test_repair_never_kills_the_last_host(self):
+        import random
+
+        events = [
+            FaultEvent(at_tick=5, action="kill_host", host="h1"),
+            FaultEvent(at_tick=8, action="kill_host", host="h2"),
+        ]
+        repaired = fuzzer.repair(
+            "small_multihost", events, random.Random(0)
+        )
+        assert [e.action for e in repaired] == ["kill_host"]
+
+    def test_mutations_stay_fair(self):
+        import random
+
+        rng = random.Random(7)
+        parent = fuzzer.generate("small_multihost", rng)
+        for _ in range(30):
+            child = fuzzer.mutate(
+                "small_multihost", parent, rng, pool=[parent]
+            )
+            assert fuzzer.is_fair("small_multihost", child)
+            parent = child or parent
+
+
+# ---------------------------------------------------------------------------
+# satellite: the ddmin shrinker (property-tested on synthetic oracles)
+# ---------------------------------------------------------------------------
+
+
+def _ev(tick: int, action: str = "blip", host: str = "h1") -> FaultEvent:
+    return FaultEvent(at_tick=tick, action=action, host=host)
+
+
+class TestShrinker:
+    async def test_shrinks_to_the_single_culprit(self):
+        culprit = _ev(9, "kill_host", "h2")
+        events = [_ev(t) for t in range(1, 8)] + [culprit]
+
+        async def still_fails(cand):
+            return culprit in cand
+
+        minimal, runs = await fuzzer.shrink(events, still_fails)
+        assert minimal == [culprit]
+        assert runs < len(events) * 4
+
+    async def test_minimal_schedule_is_locally_minimal(self):
+        """The satellite property: the minimized schedule still fails,
+        and removing ANY single remaining event makes it pass."""
+        needed = {_ev(3, "kill_host", "h1"), _ev(11, "kill_host", "h2")}
+        noise = [_ev(t) for t in (2, 5, 7, 13, 17)]
+
+        async def still_fails(cand):
+            return needed <= set(cand)  # fails only with BOTH culprits
+
+        minimal, _ = await fuzzer.shrink(
+            list(needed) + noise, still_fails
+        )
+        assert await still_fails(minimal)
+        for i in range(len(minimal)):
+            assert not await still_fails(minimal[:i] + minimal[i + 1:]), (
+                f"removing event {i} should have made the schedule pass"
+            )
+
+    async def test_respects_run_budget(self):
+        calls = 0
+
+        async def still_fails(cand):
+            nonlocal calls
+            calls += 1
+            return True  # pathological oracle: everything "fails"
+
+        await fuzzer.shrink([_ev(t) for t in range(1, 20)],
+                            still_fails, max_runs=10)
+        assert calls <= 10
+
+
+# ---------------------------------------------------------------------------
+# universal invariants ride along on every scenario run
+# ---------------------------------------------------------------------------
+
+
+class TestUniversalInvariants:
+    async def test_every_run_carries_the_whole_library(self):
+        from bioengine_tpu.testing.invariants import UNIVERSAL_INVARIANTS
+
+        result = await fuzzer.run_schedule("routed_local", [], seed=3)
+        for name in UNIVERSAL_INVARIANTS:
+            assert name in result["invariants"], name
+            v = result["invariants"][name]
+            assert v["required"] and v.get("universal")
+        assert result["passed"], result["invariants"]
+        assert result["flight_event_types"], (
+            "coverage signature needs flight event types"
+        )
+
+    async def test_watchdog_fails_typed_instead_of_hanging(self):
+        """Satellite: a livelocked run is cut at the watchdog, the
+        watchdog_timeout invariant goes red, and unresolved requests
+        fail typed — the suite never hangs."""
+        from dataclasses import replace as dc_replace
+
+        topo = fuzzer.TOPOLOGIES["routed_local"]
+        scenario = dc_replace(
+            topo, name="fuzz_watchdog_probe",
+            ticks=4, service_s=30.0, watchdog_s=0.8, deadline_s=0.9,
+        )
+        from bioengine_tpu.testing.scenarios import run_scenario_async
+
+        result = await run_scenario_async(scenario, seed=0)
+        assert not result["passed"]
+        assert not result["invariants"]["watchdog_timeout"]["ok"]
+        assert any(
+            out and "WatchdogTimeout" in out
+            for out in result["outcomes"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end drill: find + shrink a KNOWN lease-accounting bug
+# ---------------------------------------------------------------------------
+
+
+class TestDrill:
+    async def test_drill_bug_found_and_shrunk_to_minimal_repro(self):
+        """The trimmed acceptance drill: hand the shrinker a noisy
+        schedule over the armed defect; it must isolate the kill_host
+        in <= 3 events (it lands on exactly 1)."""
+        noisy = [
+            FaultEvent(at_tick=3, action="clock_skew", skew_s=2.0),
+            FaultEvent(at_tick=7, action="kill_host", host="h2"),
+            FaultEvent(at_tick=9, action="traffic_burst", burst=6),
+        ]
+        with fuzzer._env_overlay({"BIOENGINE_FUZZ_DRILL": "1"}):
+            first = await fuzzer.run_schedule(
+                "small_multihost", noisy, seed=5
+            )
+            red = fuzzer.red_set(first)
+            assert "lease_conservation" in red, first["invariants"]
+
+            async def still_fails(cand):
+                if not fuzzer.is_fair("small_multihost", cand):
+                    return False
+                r = await fuzzer.run_schedule(
+                    "small_multihost", cand, seed=5
+                )
+                return red <= fuzzer.red_set(r)
+
+            minimal, _ = await fuzzer.shrink(noisy, still_fails)
+        assert len(minimal) <= 3
+        assert [e.action for e in minimal] == ["kill_host"]
+
+    async def test_clean_engine_passes_the_drill_schedule(self):
+        """Without the flag the same schedule is green — the defect is
+        real, gated, and the invariant does not false-positive on an
+        ordinary host death."""
+        result = await fuzzer.run_schedule(
+            "small_multihost",
+            [FaultEvent(at_tick=7, action="kill_host", host="h2")],
+            seed=5,
+        )
+        assert result["passed"], result["invariants"]
+
+    @pytest.mark.slow
+    async def test_full_search_finds_the_drill_bug(self):
+        """The untrimmed loop: coverage-guided search from scratch must
+        find the armed defect and shrink it within a CI-sized budget."""
+        out = await fuzzer.fuzz(
+            topology="small_multihost", seed=1, budget_s=120.0,
+            drill=True,
+        )
+        assert out["artifacts"], out["stats"]
+        art = out["artifacts"][0]
+        assert art["expect"]["red"] == ["lease_conservation"]
+        assert len(art["events"]) <= 3
+
+
+# ---------------------------------------------------------------------------
+# satellite: corpus artifacts replay bit-deterministically
+# ---------------------------------------------------------------------------
+
+
+class TestCorpusReplay:
+    def test_corpus_is_present_and_well_formed(self):
+        paths = sorted(CORPUS_DIR.glob("*.json"))
+        assert paths, "tests/fuzz_corpus must hold at least the drill repro"
+        for path in paths:
+            art = fuzzer.load_artifact(path)  # validates kind/version
+            assert art["events"], path
+            assert set(art["env"]) <= set(fuzzer.ARTIFACT_ENV_ALLOWLIST)
+
+    @pytest.mark.parametrize(
+        "path", sorted(CORPUS_DIR.glob("*.json")), ids=lambda p: p.stem
+    )
+    async def test_corpus_artifact_replays_identically_twice(self, path):
+        """Satellite determinism gate: two replays of a checked-in
+        artifact produce identical outcome_signatures AND the recorded
+        red set still reproduces."""
+        verdict = await fuzzer.replay_artifact(path, check_determinism=True)
+        assert verdict["deterministic"] is True
+        assert verdict["matches_expect"], (
+            f"{path.name}: red={verdict['red']}"
+        )
+
+    async def test_env_overlay_is_scoped_and_allowlisted(self):
+        import os
+
+        art = {"BIOENGINE_FUZZ_DRILL": "1", "PATH": "/evil"}
+        before = os.environ.get("PATH")
+        with fuzzer._env_overlay(art):
+            assert os.environ.get("BIOENGINE_FUZZ_DRILL") == "1"
+            assert os.environ.get("PATH") == before  # not allowlisted
+        assert os.environ.get("BIOENGINE_FUZZ_DRILL") is None
+
+    def test_artifact_roundtrip(self, tmp_path):
+        events = [FaultEvent(at_tick=4, action="kill_host", host="h1")]
+        art = {
+            "kind": fuzzer.ARTIFACT_KIND,
+            "version": fuzzer.ARTIFACT_VERSION,
+            "topology": "small_multihost",
+            "seed": 9,
+            "events": fuzzer.schedule_to_json(events),
+            "env": {},
+            "expect": {"passed": True, "red": []},
+            "outcome_signature": "x",
+            "note": "",
+        }
+        path = fuzzer.save_artifact(tmp_path / "a.json", art)
+        loaded = fuzzer.load_artifact(path)
+        assert fuzzer.schedule_from_json(loaded["events"]) == events
+
+    def test_load_rejects_foreign_json(self, tmp_path):
+        p = tmp_path / "x.json"
+        p.write_text(json.dumps({"kind": "something-else"}))
+        with pytest.raises(fuzzer.FuzzError):
+            fuzzer.load_artifact(p)
+
+
+# ---------------------------------------------------------------------------
+# search-loop plumbing that must not regress silently
+# ---------------------------------------------------------------------------
+
+
+class TestSearchLoop:
+    async def test_coverage_key_separates_outcome_shapes(self):
+        clean = await fuzzer.run_schedule("routed_local", [], seed=3)
+        burst = await fuzzer.run_schedule(
+            "routed_local",
+            [FaultEvent(at_tick=5, action="kill_router", host="r0")],
+            seed=3,
+        )
+        assert fuzzer.coverage_key(clean) != fuzzer.coverage_key(burst)
+
+    async def test_fuzz_rejects_unknown_topology(self):
+        with pytest.raises(fuzzer.FuzzError):
+            await fuzzer.run_schedule("no_such_topology", [], 0)
+        with pytest.raises(fuzzer.FuzzError):
+            await fuzzer.fuzz(topology="no_such_topology", budget_s=1)
+
+    async def test_signature_stable_across_back_to_back_runs(self):
+        """The substrate's one-seed determinism contract, as consumed
+        by the fuzzer: same topology + schedule + seed → identical
+        outcome signature, twice in the same process."""
+        events = [FaultEvent(at_tick=6, action="kill_router", host="r1")]
+        a = await fuzzer.run_schedule("routed_local", events, seed=8)
+        b = await fuzzer.run_schedule("routed_local", events, seed=8)
+        assert outcome_signature(a) == outcome_signature(b)

@@ -537,3 +537,49 @@ class TestSharedContract:
 
         assert controller_mod.DeploymentHandle is DeploymentHandle
         assert DeploymentHandle.__module__ == "bioengine_tpu.serving.router"
+
+
+# ---------------------------------------------------------------------------
+# pick-miss health wake
+# ---------------------------------------------------------------------------
+
+
+class TestPickMissHealthWake:
+    """A request waiting in ``_pick_replica_wait`` with nothing routable
+    rings ``_wake_health`` — the same signal a breaker trip sends — so
+    the health loop runs its restart/top-up pass NOW instead of up to
+    ``health_check_period`` later. Found by the chaos fuzzer: a host
+    rejoining after a blip sat unplaced for a request's whole deadline
+    because nothing woke placement."""
+
+    async def test_pick_miss_sets_wake_health(self, controller):
+        import time
+
+        from bioengine_tpu.serving.errors import NoHealthyReplicasError
+
+        for r in controller.apps["app"].replicas["dep"]:
+            r.state = ReplicaState.UNHEALTHY
+        controller._wake_health.clear()
+        with pytest.raises(NoHealthyReplicasError):
+            await controller._pick_replica_wait(
+                "app", "dep", deadline=time.monotonic() + 0.3
+            )
+        assert controller._wake_health.is_set()
+
+    async def test_waiting_request_recovers_via_woken_health_loop(self):
+        """End to end: every replica is unroutable, the health loop is
+        idle on a 3600 s period — only the pick-miss wake can save the
+        request before its deadline. It must."""
+        c = ServeController(ClusterState(), health_check_period=3600)
+        await _deploy(c, n=1)
+        await c.start()
+        try:
+            for r in c.apps["app"].replicas["dep"]:
+                r.state = ReplicaState.UNHEALTHY
+            handle = c.get_handle("app", "dep")
+            result = await handle.call(
+                "work", 2, 3, options=RequestOptions(deadline_s=5.0)
+            )
+            assert result["sum"] == 5
+        finally:
+            await c.stop()
