@@ -64,7 +64,7 @@ Env overrides:
                         multihost_mesh,cold_start,cellpose,search,
                         observability_overhead,scheduler_goodput,flash,
                         unet3d,ivfpq,pqflat,rpc_transport,
-                        request_overhead,router_scaling
+                        request_overhead,router_scaling,token_streaming
   BENCH_ROUTER_LEGS=a,b router counts for the router_scaling stage
                         (default 1,2,4,8)
   BENCH_PROBE_CADENCE=N seconds between tunnel probes while wedged
@@ -107,6 +107,7 @@ STAGE_COSTS = {
     "rpc_transport": 60,
     "request_overhead": 30,
     "router_scaling": 30,
+    "token_streaming": 45,
 }
 DEFAULT_CONFIGS = tuple(STAGE_COSTS)
 
@@ -2581,6 +2582,142 @@ def _bench_router_scaling(cpu: bool) -> dict:  # noqa: ARG001 — pure host path
     return out
 
 
+def _bench_token_streaming(cpu: bool) -> dict:  # noqa: ARG001 — toy decoder is cpu-native
+    """Decode-path serving economics over the real DecodeEngine (paged
+    KV cache, bucketed compiles) driven by the step-level continuous
+    batcher (serving/decode.py).
+
+    Three legs: ``throughput`` co-batches BENCH_TS_STREAMS bulk
+    generations and reports tokens/s, tokens/s/chip and the mean batch
+    occupancy (THE efficiency number of continuous batching);
+    ``inter_token`` measures a solo interactive stream's time-to-first-
+    token and inter-token gap distribution (the latency the
+    ``inter_token_ms`` SLO governs); ``join_mid_batch`` is the
+    no-head-of-line-blocking proof — a short interactive generation is
+    admitted into a RUNNING long-generation batch (``joined_mid_batch``
+    = 1), gets its first token in ``mid_batch_ttft_ms``, and finishes
+    while the long generation is still going (``long_still_running`` =
+    1) — the leg a request-level batcher structurally cannot pass.
+
+    Every leg runs once untimed first so the timed pass measures
+    steady-state decode, not bucket compiles.
+
+    Env: BENCH_TS_STREAMS (default 8), BENCH_TS_TOKENS (default 48)."""
+    import asyncio
+
+    from bioengine_tpu.runtime.decode_engine import DecodeEngine
+    from bioengine_tpu.serving.decode import DecodeLoop
+
+    n_streams = int(os.environ.get("BENCH_TS_STREAMS", "8"))
+    n_tokens = int(os.environ.get("BENCH_TS_TOKENS", "48"))
+    prompt = [ord(c) % 256 for c in "the cell divides and grows"][:16]
+
+    engine = DecodeEngine()
+    engine.warmup(prompt_lens=(len(prompt),), batches=(1, n_streams))
+
+    async def drain(stream) -> dict:
+        toks: list = []
+        gaps: list = []
+        ttft = 0.0
+        t_sub = time.perf_counter()
+        t_prev = None
+        async for tok in stream.tokens():
+            now = time.perf_counter()
+            if t_prev is None:
+                ttft = now - t_sub
+            else:
+                gaps.append(now - t_prev)
+            t_prev = now
+            toks.append(tok)
+        return {"tokens": toks, "ttft_s": ttft, "gaps": gaps}
+
+    def _q(vals: list, q: float) -> float:
+        s = sorted(vals)
+        return s[min(int(len(s) * q), len(s) - 1)] if s else 0.0
+
+    async def throughput_leg() -> dict:
+        # reserve disabled: this is the bulk-only capacity leg, and the
+        # interactive reserve would (correctly) hold one slot empty
+        loop = DecodeLoop(
+            engine, name="bench-tp", max_active=n_streams,
+            interactive_reserve=0,
+        )
+        t0 = time.perf_counter()
+        outs = await asyncio.gather(
+            *[
+                drain(loop.submit(prompt, n_tokens, klass="bulk"))
+                for _ in range(n_streams)
+            ]
+        )
+        wall = time.perf_counter() - t0
+        stats = loop.stats
+        await loop.close()
+        total = sum(len(o["tokens"]) for o in outs)
+        return {
+            "streams": n_streams,
+            "new_tokens_each": n_tokens,
+            "tokens_per_sec": round(total / wall, 1),
+            "tokens_per_sec_per_chip": round(
+                total / wall / engine.chip_width, 1
+            ),
+            "batch_occupancy": round(stats["occupancy"]["mean"], 2),
+            "steps": stats["steps"],
+            "wall_s": round(wall, 3),
+        }
+
+    async def inter_token_leg() -> dict:
+        loop = DecodeLoop(engine, name="bench-it", max_active=2)
+        out = await drain(loop.submit(prompt, n_tokens, klass="interactive"))
+        await loop.close()
+        gaps_ms = [1000.0 * g for g in out["gaps"]]
+        return {
+            "ttft_ms": round(1000.0 * out["ttft_s"], 3),
+            "inter_token_p50_ms": round(_q(gaps_ms, 0.5), 3),
+            "inter_token_p99_ms": round(_q(gaps_ms, 0.99), 3),
+        }
+
+    async def join_leg() -> dict:
+        loop = DecodeLoop(
+            engine, name="bench-join", max_active=4, interactive_reserve=1
+        )
+        long_stream = loop.submit(prompt, 2 * n_tokens, klass="bulk")
+        long_task = asyncio.create_task(drain(long_stream))
+        # wait until the long generation is demonstrably mid-batch
+        while loop.stats["tokens"] < 8:
+            await asyncio.sleep(0.001)
+        t0 = time.perf_counter()
+        short_stream = loop.submit(prompt, 8, klass="interactive")
+        short = await drain(short_stream)
+        short_wall = time.perf_counter() - t0
+        long_still_running = int(not long_task.done())
+        long_out = await long_task
+        await loop.close()
+        return {
+            "joined_mid_batch": int(short_stream.joined_mid_batch),
+            "mid_batch_ttft_ms": round(1000.0 * short["ttft_s"], 3),
+            "short_wall_ms": round(1000.0 * short_wall, 3),
+            "long_still_running": long_still_running,
+            "long_tokens": len(long_out["tokens"]),
+        }
+
+    async def run() -> dict:
+        # untimed pass: compile every (batch bucket, KV bucket) the
+        # timed legs will touch
+        await throughput_leg()
+        await join_leg()
+        return {
+            "throughput": await throughput_leg(),
+            "inter_token": await inter_token_leg(),
+            "join_mid_batch": await join_leg(),
+            "engine": {
+                "n_devices": engine.chip_width,
+                "kv_block_size": engine.kv.block_size,
+            },
+        }
+
+    return asyncio.run(run())
+
+
 def worker_main() -> int:
     cpu = os.environ.get("BENCH_PLATFORM", "").lower() == "cpu"
     if cpu:
@@ -2652,6 +2789,7 @@ def worker_main() -> int:
         "rpc_transport": _bench_rpc_transport,
         "request_overhead": _bench_request_overhead,
         "router_scaling": _bench_router_scaling,
+        "token_streaming": _bench_token_streaming,
     }
     if os.environ.get("BENCH_SLEEP_S"):
         # test-only stage (tests/test_bench.py): a deterministic
@@ -2968,6 +3106,7 @@ def _final_json(shared: _Shared, deadline_hit: bool) -> str:
             "rpc_transport": shared.stages.get("rpc_transport"),
             "request_overhead": shared.stages.get("request_overhead"),
             "router_scaling": shared.stages.get("router_scaling"),
+            "token_streaming": shared.stages.get("token_streaming"),
             "observability_overhead": shared.stages.get(
                 "observability_overhead"
             ),

@@ -135,6 +135,14 @@ HOT_PATH_ROOT_CATALOG: tuple[tuple[str, str], ...] = (
     ("bioengine_tpu.rpc.server", "RpcServer._dispatch"),
     ("bioengine_tpu.rpc.server", "RpcServer.call_service_method"),
     ("bioengine_tpu.runtime.engine", "InferenceEngine.predict"),
+    # token streaming: the per-token send path and the per-step decode
+    # driver run once per generated token / batched forward — the
+    # tightest loops the serving tier owns
+    ("bioengine_tpu.serving.router", "DeploymentHandle.call_stream"),
+    ("bioengine_tpu.serving.decode", "DecodeLoop._run"),
+    ("bioengine_tpu.runtime.decode_engine", "DecodeEngine.step"),
+    ("bioengine_tpu.rpc.server", "RpcServer._send_stream_item"),
+    ("bioengine_tpu.rpc.client", "ServerConnection._send_stream_item"),
 )
 
 _ADVICE = {

@@ -97,7 +97,8 @@ _CONSTRUCTOR_NAMES = {"__init__", "__post_init__", "__new__"}
 #   <conn>.call("service-id", "verb", ...)          both strings constant
 #   <x>._call_host(service_var, "verb", ...)        verb constant
 #   <x>.call_service_method(service_var, "verb", ...)
-_VERB_CALL_ATTRS = {"_call_host", "call_service_method"}
+#   <x>._stream_host(service_var, "verb", ...)      streaming twin
+_VERB_CALL_ATTRS = {"_call_host", "call_service_method", "_stream_host"}
 
 # dict literals in these functions register verbs even when the dict is
 # returned rather than passed straight to register_service (the

@@ -10,6 +10,7 @@ so worker/app code reads the same against our in-repo control plane.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 from typing import Any, Callable, Optional
 
 import aiohttp
@@ -97,6 +98,7 @@ class ServerConnection:
                 protocol.PROTO_MESH1,
                 protocol.PROTO_EPOCH1,
                 protocol.PROTO_FAST1,
+                protocol.PROTO_STREAM1,
             ]
             if protocols is None
             else list(protocols)
@@ -125,6 +127,10 @@ class ServerConnection:
         self._session: Optional[aiohttp.ClientSession] = None
         self._ws: Optional[aiohttp.ClientWebSocketResponse] = None
         self._pending: dict[str, asyncio.Future] = {}
+        # open streaming calls: call_id -> queue of ("item", seq, value)
+        # / ("end", count, spans) / ("err", 0, exc) — fed by the read
+        # loop, drained by call_stream
+        self._streams: dict[str, asyncio.Queue] = {}
         # call ids need per-connection uniqueness, not global entropy:
         # one random prefix at construction, then a counter — minting
         # 64 random bits per request shows up on the microsecond path
@@ -305,6 +311,18 @@ class ServerConnection:
                             fut = self._pending.pop(parsed[0], None)
                             if fut is not None and not fut.done():
                                 fut.set_result(parsed[1])
+                            elif parsed[0] in self._streams:
+                                # closing RESULT of a streaming call:
+                                # fast result frames carry no spans
+                                self._streams[parsed[0]].put_nowait(
+                                    ("end", parsed[1], None)
+                                )
+                            continue
+                        sparsed = self.codec.decode_fast_stream_frame(raw)
+                        if sparsed is not None:
+                            q = self._streams.get(sparsed[0])
+                            if q is not None:
+                                q.put_nowait(("item", sparsed[1], sparsed[2]))
                             continue
                         data = self.codec.decode_fast_frame(raw)
                     else:
@@ -333,7 +351,8 @@ class ServerConnection:
                         # serving our call — fold into the local buffer
                         # so one process holds the whole tree
                         tracing.absorb_spans(data["spans"])
-                    fut = self._pending.pop(data.get("call_id", ""), None)
+                    call_id = data.get("call_id", "")
+                    fut = self._pending.pop(call_id, None)
                     if fut and not fut.done():
                         if t == protocol.RESULT:
                             fut.set_result(data.get("result"))
@@ -342,6 +361,21 @@ class ServerConnection:
                             if not isinstance(err, Exception):
                                 err = RuntimeError(str(err))
                             fut.set_exception(err)
+                    elif call_id in self._streams:
+                        q = self._streams[call_id]
+                        if t == protocol.RESULT:
+                            q.put_nowait(("end", data.get("result"), None))
+                        else:
+                            err = data.get("error")
+                            if not isinstance(err, Exception):
+                                err = RuntimeError(str(err))
+                            q.put_nowait(("err", 0, err))
+                elif t == protocol.STREAM:
+                    q = self._streams.get(data.get("call_id", ""))
+                    if q is not None:
+                        q.put_nowait(
+                            ("item", data.get("seq", 0), data.get("item"))
+                        )
                 elif t == protocol.CALL:
                     spawn_supervised(
                         self._handle_incoming_call(data),
@@ -406,6 +440,12 @@ class ServerConnection:
                 # first) never awaits this future — mark the exception
                 # retrieved so the loop doesn't report it at GC time
                 fut.exception()
+        # open streams see the SAME typed transport error as unary
+        # calls — the serving layer's idempotent-failover rules key on
+        # ConnectionLost, streams included
+        streams, self._streams = self._streams, {}
+        for q in streams.values():
+            q.put_nowait(("err", 0, exc))
 
     async def _reconnect_loop(self) -> None:
         """Re-establish with exponential backoff + full jitter, then
@@ -461,6 +501,27 @@ class ServerConnection:
                 await ws.send_bytes(frame)
                 return
         for frame in await codec.encode_frames_async(msg):
+            await ws.send_bytes(frame)
+
+    async def _send_stream_item(self, call_id: str, seq: int, item: Any) -> None:
+        """One stream item to the server. Per-token sends are THE hot
+        path of a generation — try the BEFS stream frame first and only
+        build the STREAM envelope dict on fallback (mirrors
+        ``_request_fast``'s inlined send)."""
+        if faults.ACTIVE:
+            await faults.hit("rpc.client.send", drop=self._abort_connection)
+        ws = self._ws
+        if ws is None or ws.closed:
+            raise ConnectionLost("rpc connection is down")
+        codec = self.codec
+        if codec.fast:
+            frame = codec.encode_fast_stream_frame(call_id, seq, item)
+            if frame is not None:
+                await ws.send_bytes(frame)
+                return
+        for frame in await codec.encode_frames_async(
+            {"t": protocol.STREAM, "call_id": call_id, "seq": seq, "item": item}
+        ):
             await ws.send_bytes(frame)
 
     async def _abort_connection(self) -> None:
@@ -536,6 +597,32 @@ class ServerConnection:
                 result = fn(*msg.get("args", []), **msg.get("kwargs", {}))
                 if asyncio.iscoroutine(result):
                     result = await result
+            if hasattr(result, "__aiter__"):
+                if msg.get("stream"):
+                    # streaming handler for a streaming caller: one
+                    # STREAM frame per item (fast-encoded when small),
+                    # closed by a RESULT carrying the item count so the
+                    # caller can detect truncation
+                    seq = 0
+                    try:
+                        async for item in result:
+                            await self._send_stream_item(
+                                msg.get("call_id"), seq, item
+                            )
+                            seq += 1
+                    except BaseException:
+                        # a failed send mid-stream must not leave the
+                        # provider's generator suspended until GC — its
+                        # finally blocks release decode slots / ongoing
+                        # counts, so close it deterministically
+                        with contextlib.suppress(Exception):
+                            await result.aclose()
+                        raise
+                    result = {"n": seq}
+                else:
+                    # legacy caller on a streaming method: drain to a
+                    # list so the method stays callable without stream1
+                    result = [item async for item in result]
             await self._send_msg(
                 {
                     "t": protocol.RESULT,
@@ -618,6 +705,71 @@ class ServerConnection:
         if traced:
             msg["trace"] = ctx.to_wire()
         return await self._request(msg)
+
+    async def call_stream(
+        self,
+        service_id: str,
+        method: str,
+        *args,
+        item_timeout: Optional[float] = None,
+        **kwargs,
+    ):
+        """Call a streaming service method; async-iterates its items.
+
+        The CALL carries ``stream: True``; the provider sends one
+        STREAM frame per item and closes with a counting RESULT. A
+        per-item inactivity timeout (default: the connection timeout)
+        replaces the unary whole-call timer — a healthy generation may
+        run far longer than any single gap between tokens. Out-of-order
+        or missing items raise :class:`ConnectionLost` (the transport
+        guarantees ordering, so a gap means frames were lost to a drop
+        mid-stream)."""
+        if self.peer_protocols and not self.peer_supports(protocol.PROTO_STREAM1):
+            raise RuntimeError(
+                "server does not support streaming calls (stream1)"
+            )
+        self._call_seq = seq = self._call_seq + 1
+        call_id = f"{self._call_prefix}{seq:x}"
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[call_id] = q
+        msg: dict[str, Any] = {
+            "t": protocol.CALL,
+            "call_id": call_id,
+            "service_id": service_id,
+            "method": method,
+            "args": list(args),
+            "kwargs": kwargs,
+            "stream": True,
+        }
+        ctx = tracing.current_trace()
+        if self.codec.trace and ctx is not None and ctx.sampled:
+            msg["trace"] = ctx.to_wire()
+        gap = item_timeout if item_timeout is not None else self.timeout
+        expected = 0
+        try:
+            await self._send_msg(msg)
+            while True:
+                kind, a, b = await asyncio.wait_for(q.get(), gap)
+                if kind == "item":
+                    if a != expected:
+                        raise ConnectionLost(
+                            f"stream {call_id} gap: expected item "
+                            f"{expected}, got {a}"
+                        )
+                    expected += 1
+                    yield b
+                elif kind == "end":
+                    n = a.get("n") if isinstance(a, dict) else None
+                    if n is not None and n != expected:
+                        raise ConnectionLost(
+                            f"stream {call_id} truncated: provider sent "
+                            f"{n} items, received {expected}"
+                        )
+                    return
+                else:
+                    raise b
+        finally:
+            self._streams.pop(call_id, None)
 
     async def _request_fast(
         self, service_id: str, method: str, args: tuple, kwargs: dict

@@ -63,6 +63,9 @@ LIST = "list_services"
 PING = "ping"
 PONG = "pong"
 SHM_ACK = "shm_ack"            # client proves it mapped the shared store
+STREAM = "stream"              # provider -> server -> caller: one item of
+                               # a streaming call (ordered by seq; the
+                               # closing RESULT carries the final count)
 
 # wire identifiers
 OOB_MAGIC = b"BEF1"            # out-of-band scatter-gather frame
@@ -77,6 +80,10 @@ PROTO_MESH1 = "mesh1"          # cross-host mesh shards (mesh_shard on
 PROTO_EPOCH1 = "epoch1"        # controller-epoch fencing: epoch kwarg on
                                # placement/lifecycle verbs, rejected typed
                                # when stale (StaleEpochError)
+PROTO_STREAM1 = "stream1"      # streaming calls: async-generator service
+                               # methods emit per-item STREAM frames
+                               # (fast-frame kind 3 when eligible) closed
+                               # by a counting RESULT
 
 EXT_NDARRAY = 1                # legacy inline array (double-packed)
 EXT_EXCEPTION = 2
@@ -351,6 +358,7 @@ def decode_oob(data, shm_get: Optional[Callable] = None) -> dict:
 
 FAST_KIND_CALL = 1
 FAST_KIND_RESULT = 2
+FAST_KIND_STREAM = 3           # str16 call_id | u32 seq | value item
 
 _FT_NONE = 0
 _FT_TRUE = 1
@@ -379,6 +387,7 @@ _UNPACK_I = struct.Struct("<I").unpack_from
 
 _FAST_CALL_PREFIX = FAST_MAGIC + bytes([FAST_KIND_CALL])
 _FAST_RESULT_PREFIX = FAST_MAGIC + bytes([FAST_KIND_RESULT])
+_FAST_STREAM_PREFIX = FAST_MAGIC + bytes([FAST_KIND_STREAM])
 
 
 class _FastUnsupported(Exception):
@@ -555,6 +564,46 @@ def encode_fast_result(
         return None
 
 
+def encode_fast_stream(
+    call_id: str,
+    seq: int,
+    item: Any,
+    limit: int = FAST_THRESHOLD_DEFAULT,
+    scratch: Optional[bytearray] = None,
+) -> Optional[bytes]:
+    """One stream item as a BEFS frame. Per-token sends are the entire
+    point of the stream plane — a generation emits hundreds of tiny
+    frames per request, so each rides the same single-pass fixed-layout
+    encoding as a fast RESULT. None when the item isn't fast-eligible
+    (caller falls back to the full-codec STREAM envelope)."""
+    try:
+        if type(call_id) is not str or seq < 0 or seq > 0xFFFFFFFF:
+            return None
+        out = scratch if scratch is not None else bytearray()
+        del out[:]
+        out += _FAST_STREAM_PREFIX
+        _fast_str16(out, call_id)
+        out += seq.to_bytes(4, "little")
+        _fast_pack_value(out, item, 0)
+        if len(out) > limit:
+            return None
+        return bytes(out)
+    except (_FastUnsupported, struct.error, OverflowError):
+        return None
+
+
+def decode_fast_stream(data) -> Optional[tuple]:
+    """``(call_id, seq, item)`` for a BEFS STREAM frame, None for any
+    other kind — mirrors ``decode_fast_result``."""
+    buf = bytes(data)
+    if buf[4] != FAST_KIND_STREAM:  # caller already checked the magic
+        return None
+    call_id, pos = _fast_read_str16(buf, 5)
+    seq = _UNPACK_I(buf, pos)[0]
+    item, _ = _fast_read_value(buf, pos + 4)
+    return call_id, seq, item
+
+
 def _fast_read_str16(buf: bytes, pos: int):
     n = _UNPACK_H(buf, pos)[0]  # no slice allocation on the hot path
     pos += 2
@@ -629,6 +678,11 @@ def decode_fast(data) -> dict:
         call_id, pos = _fast_read_str16(buf, pos)
         v, pos = _fast_read_value(buf, pos)
         return {"t": RESULT, "call_id": call_id, "result": v}
+    if kind == FAST_KIND_STREAM:
+        call_id, pos = _fast_read_str16(buf, pos)
+        seq = _UNPACK_I(buf, pos)[0]
+        v, _ = _fast_read_value(buf, pos + 4)
+        return {"t": STREAM, "call_id": call_id, "seq": seq, "item": v}
     raise ValueError(f"bad fast-frame kind {kind}")
 
 

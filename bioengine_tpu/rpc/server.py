@@ -20,6 +20,7 @@ Capabilities:
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import os
 import secrets
 import time
@@ -122,6 +123,10 @@ class RpcServer:
         self._client_users: dict[str, TokenInfo] = {}
         self._pending: dict[str, asyncio.Future] = {}
         self._pending_owner: dict[str, str] = {}  # call_id -> provider client
+        # open streaming calls forwarded to remote providers: call_id ->
+        # queue of ("item", seq, value) / ("end", result, None) /
+        # ("err", 0, exc), drained by call_service_stream
+        self._stream_sinks: dict[str, asyncio.Queue] = {}
         self._runner: Optional[web.AppRunner] = None
         self._site: Optional[web.TCPSite] = None
         self._static_dirs: dict[str, Any] = {}  # name -> Path
@@ -485,6 +490,113 @@ class RpcServer:
             self._pending.pop(call_id, None)
             self._pending_owner.pop(call_id, None)
 
+    async def call_service_stream(
+        self,
+        full_id: str,
+        method: str,
+        args: tuple = (),
+        kwargs: Optional[dict] = None,
+        caller: Optional[TokenInfo] = None,
+        timeout: float = 300.0,
+    ):
+        """Streaming counterpart of ``call_service_method``: async-
+        iterates the items of an async-generator service method.
+
+        Same permission/context rules. Local providers run in-process;
+        remote providers must have declared ``stream1`` at their
+        handshake (their items arrive as STREAM frames routed into a
+        per-call queue and re-yielded here, so in-process and remote
+        callers share one ordering/truncation contract). ``timeout`` is
+        a per-item inactivity bound, not a whole-stream one — a healthy
+        generation outlives any unary deadline."""
+        kwargs = dict(kwargs or {})
+        entry = self._find_service(full_id)
+        visibility = entry.definition.get("config", {}).get(
+            "visibility", "public"
+        )
+        if visibility == "protected" and caller is not None and not caller.is_admin:
+            raise PermissionError(
+                f"service '{full_id}' is protected (admin required)"
+            )
+        if entry.definition.get("config", {}).get("require_context", False):
+            kwargs["context"] = self._context_for(
+                caller
+                or TokenInfo("anonymous", self.default_workspace, time.time() + 60)
+            )
+        if entry.owner_client is None:
+            fn = entry.methods.get(method)
+            if fn is None:
+                raise AttributeError(f"{full_id} has no method '{method}'")
+            result = fn(*args, **kwargs)
+            if asyncio.iscoroutine(result):
+                result = await result
+            if not hasattr(result, "__aiter__"):
+                # unary method under a streaming call: one-item stream
+                yield result
+                return
+            try:
+                async for item in result:
+                    yield item
+            finally:
+                # closing THIS generator must deterministically close
+                # the provider's, so its finally blocks run now rather
+                # than at GC
+                with contextlib.suppress(Exception):
+                    await result.aclose()
+            return
+        # remote provider: forward as a streaming CALL, drain the sink
+        if not self.service_peer_supports(entry.full_id, protocol.PROTO_STREAM1):
+            raise RuntimeError(
+                f"provider of '{full_id}' does not support streaming "
+                "calls (stream1)"
+            )
+        ws = self._clients.get(entry.owner_client)
+        if ws is None or ws.closed:
+            raise ConnectionError(f"Provider for {full_id} is gone")
+        call_id = tracing.new_id()
+        q: asyncio.Queue = asyncio.Queue()
+        self._stream_sinks[call_id] = q
+        self._pending_owner[call_id] = entry.owner_client
+        msg = {
+            "t": protocol.CALL,
+            "call_id": call_id,
+            "service_id": entry.full_id,
+            "method": method,
+            "args": list(args),
+            "kwargs": kwargs,
+            "stream": True,
+        }
+        codec = self._client_codecs.get(entry.owner_client)
+        ctx = tracing.current_trace()
+        if codec is not None and codec.trace and ctx is not None and ctx.sampled:
+            msg["trace"] = ctx.to_wire()
+        expected = 0
+        try:
+            await self._send(ws, codec, msg)
+            while True:
+                kind, a, b = await asyncio.wait_for(q.get(), timeout)
+                if kind == "item":
+                    if a != expected:
+                        raise ConnectionError(
+                            f"stream {call_id} gap: expected item "
+                            f"{expected}, got {a}"
+                        )
+                    expected += 1
+                    yield b
+                elif kind == "end":
+                    n = a.get("n") if isinstance(a, dict) else None
+                    if n is not None and n != expected:
+                        raise ConnectionError(
+                            f"stream {call_id} truncated: provider sent "
+                            f"{n} items, received {expected}"
+                        )
+                    return
+                else:
+                    raise b
+        finally:
+            self._stream_sinks.pop(call_id, None)
+            self._pending_owner.pop(call_id, None)
+
     def _find_service(self, full_id: str) -> ServiceEntry:
         if full_id in self._services:
             return self._services[full_id]
@@ -709,6 +821,7 @@ class RpcServer:
                 protocol.PROTO_MESH1,
                 protocol.PROTO_EPOCH1,
                 protocol.PROTO_FAST1,
+                protocol.PROTO_STREAM1,
             ],
         }
         if self.epoch is not None:
@@ -767,6 +880,17 @@ class RpcServer:
                                 "kwargs": c_kwargs,
                             })
                             continue
+                        # per-token stream frames from a provider ride
+                        # straight into the caller's sink — no envelope
+                        # dict on the per-item hot path
+                        sparsed = codec.decode_fast_stream_frame(raw)
+                        if sparsed is not None:
+                            sink = self._stream_sinks.get(sparsed[0])
+                            if sink is not None:
+                                sink.put_nowait(
+                                    ("item", sparsed[1], sparsed[2])
+                                )
+                            continue
                         await self._dispatch(
                             client_id, ws, codec.decode_fast_frame(raw)
                         )
@@ -820,6 +944,16 @@ class RpcServer:
                     ConnectionError(
                         f"provider client {client_id} disconnected mid-call"
                     )
+                )
+            # streams in flight from this provider fail with the same
+            # typed error, immediately — a caller mid-generation must
+            # see the drop now, not an inter-token timeout later
+            sink = self._stream_sinks.get(call_id)
+            if sink is not None:
+                sink.put_nowait(
+                    ("err", 0, ConnectionError(
+                        f"provider client {client_id} disconnected mid-stream"
+                    ))
                 )
 
     async def _dispatch(
@@ -957,24 +1091,38 @@ class RpcServer:
                     "result": self.list_services(msg.get("workspace")),
                 },
             )
+        elif t == protocol.STREAM:
+            sink = self._stream_sinks.get(msg.get("call_id", ""))
+            if sink is not None:
+                sink.put_nowait(("item", msg.get("seq", 0), msg.get("item")))
         elif t == protocol.RESULT:
             if msg.get("spans"):
                 # spans a provider recorded while serving a sampled
                 # call — absorbed here so the control-plane process
                 # can hand back one cross-process tree via get_traces
                 tracing.absorb_spans(msg["spans"])
-            fut = self._pending.get(msg.get("call_id", ""))
+            call_id = msg.get("call_id", "")
+            fut = self._pending.get(call_id)
             if fut and not fut.done():
                 fut.set_result(msg.get("result"))
+            else:
+                sink = self._stream_sinks.get(call_id)
+                if sink is not None:
+                    sink.put_nowait(("end", msg.get("result"), None))
         elif t == protocol.ERROR:
             if msg.get("spans"):
                 tracing.absorb_spans(msg["spans"])
-            fut = self._pending.get(msg.get("call_id", ""))
+            call_id = msg.get("call_id", "")
+            err = msg.get("error")
+            if not isinstance(err, Exception):
+                err = RuntimeError(str(err))
+            fut = self._pending.get(call_id)
             if fut and not fut.done():
-                err = msg.get("error")
-                if not isinstance(err, Exception):
-                    err = RuntimeError(str(err))
                 fut.set_exception(err)
+            else:
+                sink = self._stream_sinks.get(call_id)
+                if sink is not None:
+                    sink.put_nowait(("err", 0, err))
 
     def _inline_call_plan(self, service_id, method):
         """Resolve a CALL target to a (fn, require_context, protected)
@@ -1073,13 +1221,42 @@ class RpcServer:
             ctx = tracing.TraceContext.from_wire(msg["trace"])
             token = tracing.activate(ctx)
         try:
-            result = await self.call_service_method(
-                msg["service_id"],
-                msg["method"],
-                tuple(msg.get("args", ())),
-                msg.get("kwargs", {}),
-                caller=info,
-            )
+            if msg.get("stream"):
+                # streaming call: re-send each item to the caller as it
+                # arrives (provider-side ordering is preserved by the
+                # sequential per-websocket read loop), then close with
+                # the counting RESULT
+                seq = 0
+                agen = self.call_service_stream(
+                    msg["service_id"],
+                    msg["method"],
+                    tuple(msg.get("args", ())),
+                    msg.get("kwargs", {}),
+                    caller=info,
+                )
+                try:
+                    async for item in agen:
+                        await self._send_stream_item(
+                            ws, codec, msg.get("call_id"), seq, item
+                        )
+                        seq += 1
+                except BaseException:
+                    # a failed send mid-stream must not leave the
+                    # provider's generator suspended until GC — its
+                    # finally blocks release decode slots / ongoing
+                    # counts, so close it deterministically
+                    with contextlib.suppress(Exception):
+                        await agen.aclose()
+                    raise
+                result = {"n": seq}
+            else:
+                result = await self.call_service_method(
+                    msg["service_id"],
+                    msg["method"],
+                    tuple(msg.get("args", ())),
+                    msg.get("kwargs", {}),
+                    caller=info,
+                )
             response = {
                 "t": protocol.RESULT,
                 "call_id": msg.get("call_id"),
@@ -1103,6 +1280,30 @@ class RpcServer:
                 # call args decoded from shm refs are dead once the
                 # handler returns — release their pins promptly
                 codec.drain_pins()
+
+    async def _send_stream_item(
+        self,
+        ws: web.WebSocketResponse,
+        codec: Optional[Codec],
+        call_id,
+        seq: int,
+        item,
+    ) -> None:
+        """One stream item to a caller — fast frame first (per-token
+        sends are the stream plane's hot path), STREAM envelope on
+        fallback."""
+        if codec is not None and codec.fast:
+            if faults.ACTIVE:
+                await faults.hit("rpc.server.send", drop=ws.close)
+            frame = codec.encode_fast_stream_frame(call_id, seq, item)
+            if frame is not None:
+                await ws.send_bytes(frame)
+                return
+        await self._send(
+            ws,
+            codec,
+            {"t": protocol.STREAM, "call_id": call_id, "seq": seq, "item": item},
+        )
 
     async def _send_error(
         self,

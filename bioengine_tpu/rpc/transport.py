@@ -576,6 +576,47 @@ class Codec:
         st.bytes_out += len(frame)
         return frame
 
+    def encode_fast_stream_frame(
+        self, call_id: str, seq: int, item: Any
+    ) -> Optional[bytes]:
+        """One BEFS stream-item frame — the per-token send path of a
+        streaming call never materializes the STREAM dict when this
+        hits (same unlocked-stats argument as the other fast encoders:
+        a generation is hundreds of tiny frames)."""
+        t0 = _perf_counter()
+        pool = self._fast_pool
+        scratch = pool.pop() if pool else bytearray()
+        frame = protocol.encode_fast_stream(
+            call_id, seq, item, self._fast_threshold, scratch
+        )
+        if len(scratch) <= _FAST_SCRATCH_RETAIN:
+            pool.append(scratch)
+        st = self.stats
+        if frame is None:
+            st.fast_fallbacks += 1
+            return None
+        st.small_frames_out += 1
+        st.encode_seconds += _perf_counter() - t0
+        st.msgs_out += 1
+        st.frames_out += 1
+        st.bytes_out += len(frame)
+        return frame
+
+    def decode_fast_stream_frame(self, data: bytes) -> Optional[tuple]:
+        """``(call_id, seq, item)`` for a BEFS STREAM frame, else None
+        — read loops feed the stream queue straight from the tuple."""
+        t0 = _perf_counter()
+        parsed = protocol.decode_fast_stream(data)
+        if parsed is None:
+            return None
+        st = self.stats
+        st.frames_in += 1
+        st.bytes_in += len(data)
+        st.small_frames_in += 1
+        st.msgs_in += 1
+        st.decode_seconds += _perf_counter() - t0
+        return parsed
+
     def encode_frames(self, msg: dict) -> list:
         """Encode ``msg`` into the list of websocket messages to send."""
         if self.fast:

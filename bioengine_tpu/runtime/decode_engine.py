@@ -1,0 +1,477 @@
+"""Decode-capable engine: bucketed prefill + single-token decode steps.
+
+``InferenceEngine`` serves fixed-shape batch forwards; autoregressive
+decoding is a different execution shape — a *prefill* over the prompt
+builds per-layer KV state, then a loop of batched single-token *steps*
+extends it. This module supplies that path with the same discipline the
+batch engine has:
+
+- **Programs compile once per bucket.** Prompt lengths bucket on a
+  block-size ladder (``runtime/buckets.py``), decode-step programs key
+  on ``(batch bucket, KV-length bucket)``, and both live in the shared
+  ``CompiledProgramCache`` keyed with the engine's ``_placement_key``
+  — mixed-length traffic triggers a small bounded set of compiles,
+  never one per sequence.
+- **KV state is paged.** Per-sequence KV lives in a
+  :class:`~bioengine_tpu.runtime.kv_cache.PagedKVCache` block pool;
+  a sequence joining or leaving the running batch between steps is a
+  block-table edit, not a buffer reshape.
+- **Mesh is a manifest decision.** The same ``mesh_axes={"dp": -1}``
+  spec the batch engine takes resolves over whatever chip group this
+  engine leased; dp shards the step batch row-wise, so a 1-chip lease
+  and a dp=8 CPU mesh produce bit-identical greedy tokens (rows are
+  independent) — the sharded-decoder unlock is a manifest edit.
+
+The bundled model is a deterministic seeded character-level
+transformer (vocab = 256 bytes): small enough to run hermetically on
+CPU under tier-1, real enough that golden activations pin the math
+(pre-LN attention + MLP, weight-tied logits).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from bioengine_tpu.runtime.buckets import bucket_batch, bucket_dim
+from bioengine_tpu.runtime.engine import mesh_cache_tag, resolve_devices
+from bioengine_tpu.runtime.kv_cache import PagedKVCache
+from bioengine_tpu.runtime.program_cache import (
+    CompiledProgramCache,
+    default_program_cache,
+)
+from bioengine_tpu.utils import tracing
+
+
+@dataclasses.dataclass(frozen=True)
+class DecoderConfig:
+    """Toy char-level decoder hyperparameters. The defaults fit tier-1
+    CPU budgets while exercising every structural element (multi-head
+    attention, MLP, LayerNorm, tied embeddings) the golden fixture
+    pins."""
+
+    vocab: int = 256
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 128
+    max_len: int = 512
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_decoder_params(seed: int = 0, config: DecoderConfig = DecoderConfig()) -> dict:
+    """Deterministic seeded init — the fixture generator, the app, and
+    the mesh-parity test all call this and must agree bit-for-bit."""
+    rng = np.random.default_rng(seed)
+    c = config
+
+    def w(*shape, scale):
+        return rng.normal(0.0, scale, size=shape).astype(np.float32)
+
+    params: dict[str, Any] = {
+        "tok_emb": w(c.vocab, c.d_model, scale=0.02),
+        "pos_emb": w(c.max_len, c.d_model, scale=0.02),
+        "ln_f_g": np.ones((c.d_model,), np.float32),
+        "ln_f_b": np.zeros((c.d_model,), np.float32),
+        "layers": [],
+    }
+    for _ in range(c.n_layers):
+        params["layers"].append(
+            {
+                "ln1_g": np.ones((c.d_model,), np.float32),
+                "ln1_b": np.zeros((c.d_model,), np.float32),
+                "wq": w(c.d_model, c.d_model, scale=c.d_model**-0.5),
+                "wk": w(c.d_model, c.d_model, scale=c.d_model**-0.5),
+                "wv": w(c.d_model, c.d_model, scale=c.d_model**-0.5),
+                "wo": w(c.d_model, c.d_model, scale=c.d_model**-0.5),
+                "ln2_g": np.ones((c.d_model,), np.float32),
+                "ln2_b": np.zeros((c.d_model,), np.float32),
+                "w1": w(c.d_model, c.d_ff, scale=c.d_model**-0.5),
+                "b1": np.zeros((c.d_ff,), np.float32),
+                "w2": w(c.d_ff, c.d_model, scale=c.d_ff**-0.5),
+                "b2": np.zeros((c.d_model,), np.float32),
+            }
+        )
+    return params
+
+
+def _ln(x, g, b):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+
+
+def decoder_prefill(params: dict, config: DecoderConfig, tokens, length):
+    """Full-prefix forward for ONE sequence, padded to a length bucket.
+
+    ``tokens``: int32 ``[T_pad]``; ``length``: int32 scalar (true
+    prompt length). Returns ``(logits, K, V)`` — logits ``[vocab]`` at
+    the last real position, K/V ``[n_layers, T_pad, n_heads, head_dim]``
+    (entries past ``length`` are garbage; the caller crops).
+    """
+    c = config
+    T = tokens.shape[0]
+    pos = jnp.arange(T)
+    x = params["tok_emb"][tokens] + params["pos_emb"][:T]
+    # causal AND padding mask: query q attends key k iff k <= q < length
+    causal = pos[None, :] <= pos[:, None]
+    valid = pos[None, :] < length
+    mask = jnp.where(causal & valid, 0.0, -1e30)
+    ks, vs = [], []
+    for layer in params["layers"]:
+        h = _ln(x, layer["ln1_g"], layer["ln1_b"])
+        q = (h @ layer["wq"]).reshape(T, c.n_heads, c.head_dim)
+        k = (h @ layer["wk"]).reshape(T, c.n_heads, c.head_dim)
+        v = (h @ layer["wv"]).reshape(T, c.n_heads, c.head_dim)
+        scores = jnp.einsum("qhd,khd->hqk", q, k) * (c.head_dim**-0.5)
+        attn = jax.nn.softmax(scores + mask[None], axis=-1)
+        out = jnp.einsum("hqk,khd->qhd", attn, v).reshape(T, c.d_model)
+        x = x + out @ layer["wo"]
+        h = _ln(x, layer["ln2_g"], layer["ln2_b"])
+        x = x + jax.nn.gelu(h @ layer["w1"] + layer["b1"]) @ layer["w2"] + layer["b2"]
+        ks.append(k)
+        vs.append(v)
+    x = _ln(x, params["ln_f_g"], params["ln_f_b"])
+    logits = x[length - 1] @ params["tok_emb"].T
+    return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+def decoder_step(params: dict, config: DecoderConfig, tokens, positions, K, V, lengths):
+    """One decode step for a padded batch of sequences.
+
+    ``tokens``/``positions``/``lengths``: int32 ``[B]`` (position ==
+    tokens already cached == where this token sits); ``K``/``V``:
+    ``[n_layers, B, T_pad, n_heads, head_dim]`` gathered cache state
+    (rows past ``lengths[b]`` are zero-padded and masked out). Returns
+    ``(logits, k_new, v_new)`` with logits ``[B, vocab]`` and
+    k_new/v_new ``[n_layers, B, n_heads, head_dim]`` — the KV of THIS
+    token, which the caller appends to the paged cache.
+    """
+    c = config
+    B, T = tokens.shape[0], K.shape[2]
+    x = params["tok_emb"][tokens] + params["pos_emb"][positions]
+    key_pos = jnp.arange(T)
+    mask = jnp.where(key_pos[None, :] < lengths[:, None], 0.0, -1e30)
+    k_news, v_news = [], []
+    for li, layer in enumerate(params["layers"]):
+        h = _ln(x, layer["ln1_g"], layer["ln1_b"])
+        q = (h @ layer["wq"]).reshape(B, c.n_heads, c.head_dim)
+        k_new = (h @ layer["wk"]).reshape(B, c.n_heads, c.head_dim)
+        v_new = (h @ layer["wv"]).reshape(B, c.n_heads, c.head_dim)
+        scale = c.head_dim**-0.5
+        # cached keys + this token's own key (a token always attends
+        # to itself — it is position ``lengths[b]``, past the cache)
+        scores = jnp.einsum("bhd,bthd->bht", q, K[li]) * scale + mask[:, None, :]
+        self_score = jnp.sum(q * k_new, axis=-1, keepdims=True) * scale
+        attn = jax.nn.softmax(
+            jnp.concatenate([scores, self_score], axis=-1), axis=-1
+        )
+        out = (
+            jnp.einsum("bht,bthd->bhd", attn[:, :, :T], V[li])
+            + attn[:, :, T:] * v_new
+        ).reshape(B, c.d_model)
+        x = x + out @ layer["wo"]
+        h = _ln(x, layer["ln2_g"], layer["ln2_b"])
+        x = x + jax.nn.gelu(h @ layer["w1"] + layer["b1"]) @ layer["w2"] + layer["b2"]
+        k_news.append(k_new)
+        v_news.append(v_new)
+    x = _ln(x, params["ln_f_g"], params["ln_f_b"])
+    logits = x @ params["tok_emb"].T
+    return logits, jnp.stack(k_news), jnp.stack(v_news)
+
+
+class DecodeEngine:
+    """Prefill + step execution over a leased device group.
+
+    Serving glue (``serving/decode.py`` DecodeLoop) drives three calls:
+    ``prefill(seq_id, tokens)`` admits a sequence and returns its first
+    generated token, ``step(seq_ids, tokens)`` advances a co-batch one
+    token, ``finish(seq_id)`` releases KV blocks. All greedy (argmax) —
+    determinism is what makes mid-stream failover resumable and the
+    golden fixture bit-exact.
+    """
+
+    def __init__(
+        self,
+        model_id: str = "toy-chargen",
+        params: Optional[dict] = None,
+        config: DecoderConfig = DecoderConfig(),
+        seed: int = 0,
+        cache: Optional[CompiledProgramCache] = None,
+        device: Optional[jax.Device] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+        device_ids: Optional[Sequence[int]] = None,
+        mesh_axes: Optional[Mapping[str, int]] = None,
+        kv_blocks: Optional[int] = None,
+        kv_block_size: Optional[int] = None,
+    ):
+        self.model_id = model_id
+        self.config = config
+        self.cache = cache if cache is not None else default_program_cache
+        if devices is not None:
+            self.devices = list(devices)
+        elif device_ids:
+            self.devices = resolve_devices(list(device_ids))
+        else:
+            self.devices = [device or jax.devices()[0]]
+        n = len(self.devices)
+        if mesh_axes is not None:
+            from bioengine_tpu.parallel.mesh import MeshSpec
+
+            sizes = MeshSpec(dict(mesh_axes)).resolve(n)
+            unknown = sorted(set(sizes) - {"dp"})
+            if unknown:
+                # the toy decoder carries no tp sharding rules; a
+                # silent replicate would claim a tp axis it doesn't have
+                raise ValueError(
+                    f"mesh_axes names unsupported decoder axes {unknown} "
+                    "(DecodeEngine shards the step batch over 'dp' only)"
+                )
+        self.dp = n
+        self.device = self.devices[0]
+        if n > 1:
+            from bioengine_tpu.parallel.mesh import make_mesh
+
+            self.mesh = make_mesh({"dp": self.dp}, self.devices)
+        else:
+            self.mesh = None
+        host_params = params if params is not None else init_decoder_params(seed, config)
+        if self.mesh is not None:
+            self._param_sharding = NamedSharding(self.mesh, P())
+            self.params = jax.device_put(host_params, self._param_sharding)
+        else:
+            self._param_sharding = None
+            self.params = jax.device_put(host_params, self.device)
+        self.kv = PagedKVCache(
+            config.n_layers,
+            config.n_heads,
+            config.head_dim,
+            num_blocks=kv_blocks,
+            block_size=kv_block_size,
+        )
+        bs = self.kv.block_size
+        # KV-length ladder: block-size multiples doubling up to max_len
+        # — bounded compile count, and every bucket is whole blocks so
+        # gather() never splits one
+        ladder = []
+        b = bs
+        while b < config.max_len:
+            ladder.append(b)
+            b *= 2
+        ladder.append(max(b, config.max_len))
+        self._len_ladder = tuple(ladder)
+        # one device-side dispatch thread serializes mesh access, same
+        # contract as InferenceEngine.submit
+        self._lock = threading.Lock()
+
+    # ---- mesh/program identity (mirrors InferenceEngine) --------------------
+
+    @property
+    def chip_width(self) -> int:
+        """Leased-chip multiplier for fair-share accounting: DecodeLoop
+        bills each step's wall time x this across batch members."""
+        return len(self.devices)
+
+    @property
+    def mesh_shape(self) -> Optional[dict[str, int]]:
+        return dict(self.mesh.shape) if self.mesh is not None else None
+
+    @property
+    def _mesh_key(self) -> str:
+        return mesh_cache_tag(self.dp, 1)
+
+    @property
+    def _placement_key(self) -> str:
+        ids = ",".join(str(d.id) for d in self.devices)
+        return f"{self._mesh_key}@{ids}"
+
+    def _shard(self, host: np.ndarray, batch_axis: Optional[int]):
+        """Place one step input: replicated on 1 chip, dp-sharded along
+        ``batch_axis`` on a mesh (None = replicate)."""
+        if self.mesh is None:
+            return jax.device_put(host, self.device)
+        if batch_axis is None:
+            return jax.device_put(host, NamedSharding(self.mesh, P()))
+        spec = [None] * host.ndim
+        spec[batch_axis] = "dp"
+        return jax.device_put(host, NamedSharding(self.mesh, P(*spec)))
+
+    # ---- programs -----------------------------------------------------------
+
+    def _prefill_program(self, t_pad: int):
+        key = (self.model_id, "decode_prefill", t_pad, self._placement_key)
+
+        def build():
+            cfg = self.config
+
+            def fn(params, tokens, length):
+                return decoder_prefill(params, cfg, tokens, length)
+
+            jitted = jax.jit(fn)
+            dummy_t = self._shard(np.zeros((t_pad,), np.int32), None)
+            dummy_l = self._shard(np.asarray(1, np.int32), None)
+            jax.block_until_ready(jitted(self.params, dummy_t, dummy_l))
+            return jitted
+
+        return self.cache.get_or_compile(key, build)
+
+    def _step_program(self, b_pad: int, t_pad: int):
+        key = (self.model_id, "decode_step", b_pad, t_pad, self._placement_key)
+
+        def build():
+            cfg = self.config
+
+            def fn(params, tokens, positions, K, V, lengths):
+                return decoder_step(params, cfg, tokens, positions, K, V, lengths)
+
+            jitted = jax.jit(fn)
+            z = np.zeros
+            dummy = (
+                self._shard(z((b_pad,), np.int32), 0),
+                self._shard(z((b_pad,), np.int32), 0),
+                self._shard(
+                    z((cfg.n_layers, b_pad, t_pad, cfg.n_heads, cfg.head_dim), np.float32), 1
+                ),
+                self._shard(
+                    z((cfg.n_layers, b_pad, t_pad, cfg.n_heads, cfg.head_dim), np.float32), 1
+                ),
+                self._shard(z((b_pad,), np.int32), 0),
+            )
+            jax.block_until_ready(jitted(self.params, *dummy))
+            return jitted
+
+        return self.cache.get_or_compile(key, build)
+
+    def warmup(self, prompt_lens: Sequence[int] = (16,), batches: Sequence[int] = (1,)) -> None:
+        bs = self.kv.block_size
+        for t in prompt_lens:
+            self._prefill_program(bucket_dim(t, self._len_ladder, divisor=bs))
+        for b in batches:
+            self._step_program(
+                bucket_batch(b, multiple_of=self.dp),
+                bucket_dim(max(bs, 1), self._len_ladder, divisor=bs),
+            )
+
+    # ---- decode API ---------------------------------------------------------
+
+    def prefill(self, seq_id: str, tokens: Sequence[int]) -> int:
+        """Admit a sequence: run the prompt, cache its KV, return the
+        first greedy token."""
+        width = len(self.devices)
+        t0 = time.monotonic()
+        try:
+            toks = np.asarray(tokens, np.int32)
+            T = toks.shape[0]
+            if T == 0 or T > self.config.max_len:
+                raise ValueError(
+                    f"prompt length {T} outside (0, {self.config.max_len}]"
+                )
+            bs = self.kv.block_size
+            t_pad = bucket_dim(T, self._len_ladder, divisor=bs)
+            program = self._prefill_program(t_pad)
+            padded = np.zeros((t_pad,), np.int32)
+            padded[:T] = toks
+            with self._lock:
+                logits, K, V = program(
+                    self.params,
+                    self._shard(padded, None),
+                    self._shard(np.asarray(T, np.int32), None),
+                )
+                logits = np.asarray(logits)
+                # [L, T, H, Dh] cropped to real length -> paged blocks
+                self.kv.write_prefill(
+                    seq_id, np.asarray(K)[:, :T], np.asarray(V)[:, :T]
+                )
+            tok = int(np.argmax(logits))
+            ctx = tracing.current_trace()
+            if ctx is not None and ctx.sampled:
+                with tracing.span(
+                    "decode.prefill",
+                    model=self.model_id,
+                    prompt_len=T,
+                    bucket=t_pad,
+                    mesh=self._mesh_key,
+                ) as record:
+                    record["attrs"]["chip_seconds"] = round(
+                        (time.monotonic() - t0) * width, 6
+                    )
+            return tok
+        finally:
+            tracing.add_chip_seconds((time.monotonic() - t0) * width)
+
+    def step(self, seq_ids: Sequence[str], tokens: Sequence[int]) -> list[int]:
+        """Advance a co-batch one token. ``tokens[i]`` is the last
+        generated token of ``seq_ids[i]`` (not yet in the cache); its
+        KV is computed here and appended. Returns the next greedy token
+        per sequence. This is the decode hot path — per-step work is
+        one gather, one compiled program, B appends."""
+        width = len(self.devices)
+        t0 = time.monotonic()
+        try:
+            B = len(seq_ids)
+            if B == 0:
+                return []
+            bs = self.kv.block_size
+            lengths_now = [self.kv.sequence_length(s) for s in seq_ids]
+            t_pad = bucket_dim(max(lengths_now), self._len_ladder, divisor=bs)
+            b_pad = bucket_batch(B, multiple_of=self.dp)
+            K, V, lengths = self.kv.gather(list(seq_ids), t_pad, pad_batch=b_pad)
+            toks = np.zeros((b_pad,), np.int32)
+            toks[:B] = np.asarray(tokens, np.int32)
+            program = self._step_program(b_pad, t_pad)
+            with self._lock:
+                logits, k_new, v_new = program(
+                    self.params,
+                    self._shard(toks, 0),
+                    self._shard(lengths.astype(np.int32), 0),
+                    self._shard(K, 1),
+                    self._shard(V, 1),
+                    self._shard(lengths.astype(np.int32), 0),
+                )
+                logits = np.asarray(logits)
+                k_new = np.asarray(k_new)
+                v_new = np.asarray(v_new)
+            for i, sid in enumerate(seq_ids):
+                self.kv.append(sid, k_new[:, i], v_new[:, i])
+            out = [int(t) for t in np.argmax(logits[:B], axis=-1)]
+            ctx = tracing.current_trace()
+            if ctx is not None and ctx.sampled:
+                with tracing.span(
+                    "decode.step",
+                    model=self.model_id,
+                    batch=B,
+                    batch_bucket=b_pad,
+                    kv_bucket=t_pad,
+                    mesh=self._mesh_key,
+                ) as record:
+                    record["attrs"]["chip_seconds"] = round(
+                        (time.monotonic() - t0) * width, 6
+                    )
+            return out
+        finally:
+            tracing.add_chip_seconds((time.monotonic() - t0) * width)
+
+    def finish(self, seq_id: str) -> None:
+        """Release a sequence's KV blocks (idempotent)."""
+        self.kv.unpin(seq_id)
+        self.kv.free(seq_id)
+
+    def describe(self) -> dict:
+        return {
+            "model_id": self.model_id,
+            "device_ids": [d.id for d in self.devices],
+            "n_devices": len(self.devices),
+            "mesh": self.mesh_shape,
+            "kv": self.kv.stats,
+            "config": dataclasses.asdict(self.config),
+        }

@@ -1,0 +1,306 @@
+"""Paged KV cache for autoregressive decoding.
+
+Attention state for a generating sequence grows one (K, V) entry per
+step; a naive per-sequence ``max_len`` buffer wastes HBM proportional
+to (max_len - actual_len) per sequence and couples admission to the
+worst case. This cache stores KV in fixed-size **blocks** drawn from a
+shared pool (the vLLM paged-attention layout, host-side): a sequence
+owns an ordered block table, allocation is a free-list pop, and
+freeing a finished sequence returns whole blocks — no compaction, no
+per-sequence ceiling beyond pool capacity.
+
+Keying and eviction mirror ``runtime/program_cache.py``: sequences are
+explicit keys in an LRU map, stats are first-class, and evicting an
+idle (unpinned) sequence leaves a ``decode.kv_evict`` flight event —
+an evicted resumable stream recomputes its prefix on next touch, the
+same recompile-on-re-request contract the program cache has.
+
+Capacity knobs ride ``BIOENGINE_DECODE_KV_BLOCKS`` /
+``BIOENGINE_DECODE_BLOCK_SIZE`` (read once, constructor-time — the
+append path is per-token hot).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from bioengine_tpu.utils import flight, metrics
+
+
+class KVCacheFull(RuntimeError):
+    """The block pool is exhausted and no idle sequence can be evicted.
+
+    Typed so admission control can shed (retryable) instead of the
+    engine dying mid-batch."""
+
+
+_ENV_DEFAULTS: Optional[tuple[int, int]] = None
+
+
+def env_capacity() -> tuple[int, int]:
+    """(num_blocks, block_size) from ``BIOENGINE_DECODE_KV_BLOCKS`` /
+    ``BIOENGINE_DECODE_BLOCK_SIZE``, read once per process."""
+    global _ENV_DEFAULTS
+    if _ENV_DEFAULTS is None:
+        _ENV_DEFAULTS = (
+            int(os.environ.get("BIOENGINE_DECODE_KV_BLOCKS", "512")),
+            int(os.environ.get("BIOENGINE_DECODE_BLOCK_SIZE", "16")),
+        )
+    return _ENV_DEFAULTS
+
+
+@dataclass
+class _Sequence:
+    """One live sequence: its block table and fill level."""
+
+    block_ids: list = field(default_factory=list)
+    length: int = 0          # tokens currently stored
+    pinned: bool = False     # active in a running batch — never evicted
+
+
+def _collect_kv_caches(instances: list) -> list:
+    """Scrape-time fold of live KV caches: pool pressure is the decode
+    analog of program-cache pressure — it decides whether the next
+    sequence admits, and an operator reads it next to batch occupancy."""
+    total = in_use = seqs = evictions = appends = 0
+    for c in instances:
+        s = c.stats
+        total += s["blocks_total"]
+        in_use += s["blocks_in_use"]
+        seqs += s["sequences"]
+        evictions += s["evictions"]
+        appends += s["appends"]
+    return [
+        metrics.Sample(
+            "kv_cache_blocks_total", total,
+            help="KV block pool capacity across caches",
+        ),
+        metrics.Sample(
+            "kv_cache_blocks_in_use", in_use,
+            help="KV blocks currently owned by live sequences",
+        ),
+        metrics.Sample(
+            "kv_cache_sequences", seqs,
+            help="sequences with resident KV state",
+        ),
+        metrics.Sample(
+            "kv_cache_evictions_total", evictions, kind="counter",
+            help="idle sequences evicted to reclaim KV blocks",
+        ),
+        metrics.Sample(
+            "kv_cache_appends_total", appends, kind="counter",
+            help="KV entries appended (one per decoded token per sequence)",
+        ),
+    ]
+
+
+_KV_CACHES = metrics.InstanceSet("kv_cache", _collect_kv_caches)
+
+
+class PagedKVCache:
+    """Block-pooled KV storage for one decoder's sequences.
+
+    Layout: ``k_pool``/``v_pool`` are
+    ``[n_layers, num_blocks, block_size, n_heads, head_dim]`` host
+    arrays; a sequence's logical KV ``[n_layers, T, n_heads, head_dim]``
+    lives scattered across its block table. ``gather`` materializes the
+    padded dense batch the bucketed decode-step program consumes;
+    ``append`` writes one step's KV back into the tail block.
+
+    Thread-safe: the decode loop drives it from a worker thread while
+    scrape-time collectors read stats.
+    """
+
+    def __init__(
+        self,
+        n_layers: int,
+        n_heads: int,
+        head_dim: int,
+        num_blocks: Optional[int] = None,
+        block_size: Optional[int] = None,
+        dtype=np.float32,
+    ):
+        env_blocks, env_bs = env_capacity()
+        self.n_layers = int(n_layers)
+        self.n_heads = int(n_heads)
+        self.head_dim = int(head_dim)
+        self.num_blocks = int(num_blocks if num_blocks is not None else env_blocks)
+        self.block_size = int(block_size if block_size is not None else env_bs)
+        shape = (
+            self.n_layers, self.num_blocks, self.block_size,
+            self.n_heads, self.head_dim,
+        )
+        self.k_pool = np.zeros(shape, dtype)
+        self.v_pool = np.zeros(shape, dtype)
+        self._free: list[int] = list(range(self.num_blocks - 1, -1, -1))
+        # LRU order: oldest-touched first — eviction victims pop from
+        # the front, every touch moves a sequence to the end
+        self._seqs: "OrderedDict[str, _Sequence]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._evictions = 0
+        self._appends = 0
+        _KV_CACHES.add(self)
+
+    # ---- allocation ---------------------------------------------------------
+
+    def _alloc_block_locked(self, for_seq: str) -> int:
+        if self._free:
+            return self._free.pop()
+        # pool exhausted: evict the least-recently-touched IDLE
+        # sequence (pinned = in the running batch, never a victim)
+        victim_id = next(
+            (sid for sid, s in self._seqs.items() if not s.pinned and sid != for_seq),
+            None,
+        )
+        if victim_id is None:
+            raise KVCacheFull(
+                f"kv pool exhausted ({self.num_blocks} blocks) with no "
+                f"evictable sequence — shed or raise "
+                f"BIOENGINE_DECODE_KV_BLOCKS"
+            )
+        victim = self._seqs.pop(victim_id)
+        self._free.extend(reversed(victim.block_ids))
+        self._evictions += 1
+        flight.record(
+            "decode.kv_evict",
+            seq=victim_id,
+            blocks=len(victim.block_ids),
+            tokens=victim.length,
+        )
+        return self._free.pop()
+
+    def has_sequence(self, seq_id: str) -> bool:
+        with self._lock:
+            return seq_id in self._seqs
+
+    def sequence_length(self, seq_id: str) -> int:
+        with self._lock:
+            s = self._seqs.get(seq_id)
+            return s.length if s is not None else 0
+
+    def pin(self, seq_id: str) -> None:
+        """Mark a sequence as batch-active (exempt from eviction)."""
+        with self._lock:
+            s = self._seqs.get(seq_id)
+            if s is not None:
+                s.pinned = True
+                self._seqs.move_to_end(seq_id)
+
+    def unpin(self, seq_id: str) -> None:
+        with self._lock:
+            s = self._seqs.get(seq_id)
+            if s is not None:
+                s.pinned = False
+
+    # ---- writes -------------------------------------------------------------
+
+    def write_prefill(self, seq_id: str, k: np.ndarray, v: np.ndarray) -> None:
+        """Store a prefilled prefix. ``k``/``v``:
+        ``[n_layers, T, n_heads, head_dim]`` (un-padded length)."""
+        T = k.shape[1]
+        bs = self.block_size
+        with self._lock:
+            if seq_id in self._seqs:
+                old = self._seqs.pop(seq_id)
+                self._free.extend(reversed(old.block_ids))
+            seq = _Sequence()
+            n_blocks = max(1, -(-T // bs))
+            for _ in range(n_blocks):
+                seq.block_ids.append(self._alloc_block_locked(seq_id))
+            for i, bid in enumerate(seq.block_ids):
+                lo, hi = i * bs, min((i + 1) * bs, T)
+                if lo >= T:
+                    break
+                self.k_pool[:, bid, : hi - lo] = k[:, lo:hi]
+                self.v_pool[:, bid, : hi - lo] = v[:, lo:hi]
+            seq.length = T
+            seq.pinned = True
+            self._seqs[seq_id] = seq
+
+    def append(self, seq_id: str, k_step: np.ndarray, v_step: np.ndarray) -> None:
+        """Append one decoded step's KV. ``k_step``/``v_step``:
+        ``[n_layers, n_heads, head_dim]``."""
+        bs = self.block_size
+        with self._lock:
+            seq = self._seqs.get(seq_id)
+            if seq is None:
+                raise KeyError(f"no KV state for sequence '{seq_id}'")
+            slot = seq.length % bs
+            if slot == 0 and seq.length > 0 or not seq.block_ids:
+                seq.block_ids.append(self._alloc_block_locked(seq_id))
+            bid = seq.block_ids[-1]
+            self.k_pool[:, bid, slot] = k_step
+            self.v_pool[:, bid, slot] = v_step
+            seq.length += 1
+            self._appends += 1
+            self._seqs.move_to_end(seq_id)
+
+    # ---- reads --------------------------------------------------------------
+
+    def gather(
+        self, seq_ids: list[str], pad_len: int, pad_batch: Optional[int] = None
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Dense padded batch view for the decode-step program:
+        ``(K, V, lengths)`` with K/V
+        ``[n_layers, B_pad, pad_len, n_heads, head_dim]`` and lengths
+        ``[B_pad]`` (0 for pad rows). ``pad_len`` must be a multiple of
+        ``block_size`` (the caller buckets it so)."""
+        bs = self.block_size
+        B = pad_batch if pad_batch is not None else len(seq_ids)
+        K = np.zeros(
+            (self.n_layers, B, pad_len, self.n_heads, self.head_dim),
+            self.k_pool.dtype,
+        )
+        V = np.zeros_like(K)
+        lengths = np.zeros((B,), np.int32)
+        with self._lock:
+            for b, sid in enumerate(seq_ids):
+                seq = self._seqs.get(sid)
+                if seq is None:
+                    raise KeyError(f"no KV state for sequence '{sid}'")
+                for i, bid in enumerate(seq.block_ids):
+                    lo = i * bs
+                    if lo >= seq.length:
+                        break
+                    hi = min(lo + bs, seq.length)
+                    K[:, b, lo:hi] = self.k_pool[:, bid, : hi - lo]
+                    V[:, b, lo:hi] = self.v_pool[:, bid, : hi - lo]
+                lengths[b] = seq.length
+                self._seqs.move_to_end(sid)
+        return K, V, lengths
+
+    # ---- lifecycle ----------------------------------------------------------
+
+    def free(self, seq_id: str) -> int:
+        """Release a sequence's blocks back to the pool; returns the
+        number of blocks reclaimed (0 when unknown — idempotent)."""
+        with self._lock:
+            seq = self._seqs.pop(seq_id, None)
+            if seq is None:
+                return 0
+            self._free.extend(reversed(seq.block_ids))
+            return len(seq.block_ids)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._seqs)
+
+    @property
+    def stats(self) -> dict:
+        with self._lock:
+            in_use = self.num_blocks - len(self._free)
+            return {
+                "blocks_total": self.num_blocks,
+                "blocks_in_use": in_use,
+                "block_utilization": in_use / max(1, self.num_blocks),
+                "block_size": self.block_size,
+                "sequences": len(self._seqs),
+                "evictions": self._evictions,
+                "appends": self._appends,
+            }

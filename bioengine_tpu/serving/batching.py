@@ -54,11 +54,13 @@ def _collect_batchers(instances: list) -> list:
     the one bookkeeper; this is a scrape-time reader."""
     requests = batches = batched = 0
     waits: list[float] = []
+    occupancy: list[int] = []
     for b in instances:
         requests += b._stats["requests"]
         batches += b._stats["batches"]
         batched += b._stats["batched_requests"]
         waits.extend(b._wait_samples)
+        occupancy.extend(b._occupancy_samples)
     out = [
         metrics.Sample(
             "batcher_requests_total", requests, kind="counter",
@@ -95,6 +97,31 @@ def _collect_batchers(instances: list) -> list:
                 help="recent queue wait before flush",
             )
         )
+    if occupancy:
+        # per-flush group size over a recent window — how full the
+        # batches the TPU actually saw were (the throughput half of the
+        # batching trade; queue_wait is the latency half)
+        occupancy.sort()
+        for q, idx in (
+            ("p50", len(occupancy) // 2),
+            ("p95", min(int(len(occupancy) * 0.95), len(occupancy) - 1)),
+        ):
+            out.append(
+                metrics.Sample(
+                    "batcher_occupancy",
+                    occupancy[idx],
+                    {"quantile": q},
+                    help="recent per-flush batch size",
+                )
+            )
+        out.append(
+            metrics.Sample(
+                "batcher_occupancy",
+                round(sum(occupancy) / len(occupancy), 3),
+                {"quantile": "mean"},
+                help="recent per-flush batch size",
+            )
+        )
     return out
 
 
@@ -127,6 +154,9 @@ class ContinuousBatcher:
         # queue-wait samples (seconds), recorded per request at group
         # flush; bounded so stats cost stays flat under load
         self._wait_samples: deque[float] = deque(maxlen=1024)
+        # per-flush group sizes over the same bounded window — the
+        # occupancy histogram GET /metrics serves as batcher_occupancy
+        self._occupancy_samples: deque[int] = deque(maxlen=1024)
         self._closed = False
         _BATCHERS.add(self)
 
@@ -206,6 +236,7 @@ class ContinuousBatcher:
     ) -> None:
         self._stats["batches"] += 1
         self._stats["batched_requests"] += len(group)
+        self._occupancy_samples.append(len(group))
         now = time.monotonic()
         now_wall = time.time()
         self._wait_samples.extend(now - r.enqueued_at for r in group)
@@ -270,4 +301,14 @@ class ContinuousBatcher:
             }
         else:
             s["queue_wait_ms"] = {"p50": 0.0, "p95": 0.0, "samples": 0}
+        occ = sorted(self._occupancy_samples)
+        if occ:
+            s["occupancy"] = {
+                "p50": occ[len(occ) // 2],
+                "p95": occ[min(int(len(occ) * 0.95), len(occ) - 1)],
+                "mean": round(sum(occ) / len(occ), 3),
+                "samples": len(occ),
+            }
+        else:
+            s["occupancy"] = {"p50": 0, "p95": 0, "mean": 0.0, "samples": 0}
         return s

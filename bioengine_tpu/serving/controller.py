@@ -551,6 +551,17 @@ class ServeController(RouterCore):
             **({"timeout": rpc_timeout} if rpc_timeout else {}),
         )
 
+    async def _stream_host(self, service_id: str, method: str, *args, **kwargs):
+        """Streaming twin of :meth:`_call_host`: bridges a host's
+        async-generator verb (``replica_stream``) through the RPC
+        server's stream1 plane, yielding items as their frames land."""
+        if self._rpc_server is None:
+            raise RuntimeError("controller has no RPC server attached")
+        async for item in self._rpc_server.call_service_stream(
+            service_id, method, args, kwargs
+        ):
+            yield item
+
     # ---- lifecycle ----------------------------------------------------------
 
     async def start(self) -> None:
@@ -908,6 +919,7 @@ class ServeController(RouterCore):
             host_id=host_id,
             host_service_id=service_id,
             call_host=self._call_host,
+            stream_host=self._stream_host,
             payload=spec.remote_payload or {},
             device_ids=device_ids,
             max_ongoing_requests=spec.max_ongoing_requests,
@@ -1033,6 +1045,7 @@ class ServeController(RouterCore):
             payload=spec.remote_payload or {},
             max_ongoing_requests=spec.max_ongoing_requests,
             log_sink=self.cluster_state.append_replica_log,
+            stream_host=self._stream_host,
         )
         replica.replica_id = mesh_rid
         replica.state = ReplicaState.HEALTHY
@@ -1382,6 +1395,7 @@ class ServeController(RouterCore):
             payload=spec.remote_payload,
             max_ongoing_requests=spec.max_ongoing_requests,
             log_sink=self.cluster_state.append_replica_log,
+            stream_host=self._stream_host,
         )
         for shard in plan.shards:
             shard.device_ids = self.cluster_state.host_acquire_chips(
@@ -1578,6 +1592,7 @@ class ServeController(RouterCore):
             host_id=host.host_id,
             host_service_id=host.service_id,
             call_host=self._call_host,
+            stream_host=self._stream_host,
             payload=spec.remote_payload,
             max_ongoing_requests=spec.max_ongoing_requests,
             log_sink=self.cluster_state.append_replica_log,

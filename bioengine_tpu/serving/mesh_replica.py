@@ -239,6 +239,7 @@ class MeshReplica(ReplicaStateMixin):
         max_ongoing_requests: int = 10,
         log_sink: Optional[Callable[[str, str], None]] = None,
         drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S,
+        stream_host: Optional[Callable[..., Any]] = None,
     ):
         self.app_id = app_id
         self.deployment_name = deployment_name
@@ -261,6 +262,7 @@ class MeshReplica(ReplicaStateMixin):
         self.last_error: Optional[str] = None
         self._payload = payload
         self._call_host = call_host
+        self._stream_host = stream_host
         self._ongoing = 0
         self._total_requests = 0
         self._idle_event = asyncio.Event()
@@ -636,6 +638,59 @@ class MeshReplica(ReplicaStateMixin):
                     warm_pool=False,
                 )
             return result
+        finally:
+            self._ongoing -= 1
+            if self._ongoing == 0:
+                self._idle_event.set()
+
+    async def call_stream(self, method: str, *args, **kwargs):
+        """Token stream through the mesh: the stream is driven by stage
+        0's replica (whose DecodeLoop holds the KV cache for the
+        sequence); other stages serve it via the instance's own
+        cross-shard calls, exactly like non-entry unary methods route.
+        Duck-types ``Replica.call_stream`` so DeploymentHandle's
+        streaming failover applies to mesh deployments unchanged."""
+        if self.state not in ROUTABLE_STATES:
+            raise ReplicaUnavailableError(
+                f"mesh replica {self.replica_id} not healthy ({self.state})"
+            )
+        if self._stream_host is None:
+            raise ReplicaUnavailableError(
+                f"mesh replica {self.replica_id}: control plane has no "
+                "streaming bridge"
+            )
+        shard = self.plan.shards[0]
+        self._ongoing += 1
+        self._idle_event.clear()
+        self._total_requests += 1
+        try:
+            with tracing.trace_span(
+                "mesh.stream",
+                replica=self.replica_id,
+                stage=shard.stage,
+                host=shard.host_id,
+            ):
+                agen = self._stream_host(
+                    shard.service_id,
+                    "replica_stream",
+                    self.shard_replica_id(shard.stage),
+                    method,
+                    list(args),
+                    kwargs or {},
+                )
+                async for item in agen:
+                    if not self._first_request_done:
+                        self._first_request_done = True
+                        self.ttfr["ttfr_seconds"] = round(
+                            time.monotonic() - self._started_mono, 4
+                        )
+                    yield item
+        except KeyError as e:
+            self._note_degraded(shard, e)
+            raise ReplicaUnavailableError(
+                f"mesh shard {shard.stage} host '{shard.host_id}' "
+                f"service vanished: {e}"
+            ) from e
         finally:
             self._ongoing -= 1
             if self._ongoing == 0:

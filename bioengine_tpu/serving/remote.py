@@ -51,6 +51,7 @@ class RemoteReplica(ReplicaStateMixin):
         max_ongoing_requests: int = 10,
         log_sink: Optional[Callable[[str, str], None]] = None,
         drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S,
+        stream_host: Optional[Callable[..., Any]] = None,  # async-gen (service_id, method, *args, **kw)
     ):
         self.app_id = app_id
         self.deployment_name = deployment_name
@@ -66,6 +67,7 @@ class RemoteReplica(ReplicaStateMixin):
         self.last_error: Optional[str] = None
         self._payload = payload
         self._call_host = call_host
+        self._stream_host = stream_host
         self._ongoing = 0
         self._total_requests = 0
         self._idle_event = asyncio.Event()
@@ -272,6 +274,59 @@ class RemoteReplica(ReplicaStateMixin):
             # a raw KeyError here is the ROUTER's (host service gone
             # from the registry, i.e. the websocket dropped) — app
             # exceptions always arrive wrapped as RemoteError
+            raise ReplicaUnavailableError(
+                f"host '{self.host_id}' service vanished: {e}"
+            ) from e
+        finally:
+            self._ongoing -= 1
+            if self._ongoing == 0:
+                self._idle_event.set()
+
+    async def call_stream(self, method: str, *args, **kwargs):
+        """Streaming twin of :meth:`call`: routes to the host's
+        ``replica_stream`` verb through the controller's
+        ``call_service_stream`` bridge (``stream_host``), yielding each
+        token frame as it lands. Transport failures mid-stream surface
+        as ``ConnectionError`` — the handle's resume machinery turns
+        them into an idempotent re-pick, never a silent truncation."""
+        if self.state not in ROUTABLE_STATES:
+            raise ReplicaUnavailableError(
+                f"replica {self.replica_id} not healthy ({self.state})"
+            )
+        if self._stream_host is None:
+            raise ReplicaUnavailableError(
+                f"replica {self.replica_id}: control plane has no "
+                f"streaming bridge (stream_host not wired)"
+            )
+        self._ongoing += 1
+        self._idle_event.clear()
+        self._total_requests += 1
+        try:
+            with tracing.trace_span(
+                "remote.stream",
+                replica=self.replica_id,
+                host=self.host_id,
+                method=method,
+            ):
+                agen = self._stream_host(
+                    self.host_service_id,
+                    "replica_stream",
+                    self.replica_id,
+                    method,
+                    list(args),
+                    kwargs or {},
+                )
+                first_seen = False
+                async for item in agen:
+                    if not first_seen:
+                        first_seen = True
+                        if not self._first_request_done:
+                            self._first_request_done = True
+                            self.ttfr["ttfr_seconds"] = round(
+                                time.monotonic() - self._started_mono, 4
+                            )
+                    yield item
+        except KeyError as e:
             raise ReplicaUnavailableError(
                 f"host '{self.host_id}' service vanished: {e}"
             ) from e

@@ -488,6 +488,96 @@ class Replica(ReplicaStateMixin):
         finally:
             self._semaphore.release()
 
+    async def call_stream(self, method: str, *args, **kwargs):
+        """Streaming twin of :meth:`call`: the instance method returns
+        an async iterator (a generate-style endpoint backed by
+        ``serving/decode.py``) and items are yielded to the caller as
+        they are produced. The semaphore slot is held for the WHOLE
+        stream — an in-flight generation occupies replica capacity
+        exactly like a unary call, so ``load`` and the autoscaler see
+        it — and chip-seconds accounting closes when the stream does
+        (the decode loop books fair-share device time into the
+        request-scoped accumulator per emitted token)."""
+        if self.state not in ROUTABLE_STATES:
+            raise ReplicaUnavailableError(
+                f"replica {self.replica_id} not healthy ({self.state})"
+            )
+        fn = getattr(self.instance, method, None)
+        if fn is None:
+            raise AttributeError(
+                f"{self.deployment_name} has no method '{method}'"
+            )
+        m_on = metrics.metrics_enabled()
+        self._queued += 1
+        t_park = time.monotonic()
+        try:
+            with tracing.trace_span("replica.park", replica=self.replica_id):
+                await self._semaphore.acquire()
+        finally:
+            self._queued -= 1
+        if m_on and self._m_park is not None:
+            self._m_park.observe(time.monotonic() - t_park)
+        try:
+            if self.state not in ROUTABLE_STATES:
+                raise ReplicaUnavailableError(
+                    f"replica {self.replica_id} not healthy ({self.state})"
+                )
+            self._ongoing += 1
+            self._idle_event.clear()
+            if self._requests_total is not None:
+                self._requests_total.inc()
+            t_exec = time.monotonic()
+            acc, cs_token = tracing.start_chip_accounting()
+            try:
+                with tracing.trace_span(
+                    "replica.stream",
+                    replica=self.replica_id,
+                    method=method,
+                ):
+                    result = await _maybe_await(fn(*args, **kwargs))
+                    if hasattr(result, "__aiter__"):
+                        async for item in result:
+                            yield item
+                    else:
+                        # unary method called through the stream path:
+                        # a one-item stream keeps the envelope uniform
+                        yield result
+                if not self._first_request_done:
+                    self._first_request_done = True
+                    now = time.monotonic()
+                    self.ttfr["first_request_seconds"] = round(
+                        now - t_exec, 4
+                    )
+                    self.ttfr["ttfr_seconds"] = round(
+                        now - self._started_mono, 4
+                    )
+                    flight.record(
+                        "replica.first_request",
+                        replica=self.replica_id,
+                        app=self.app_id,
+                        deployment=self.deployment_name,
+                        method=method,
+                        ttfr_seconds=self.ttfr["ttfr_seconds"],
+                        warm_pool=self.promoted_from_warm_pool,
+                    )
+            finally:
+                tracing.stop_chip_accounting(cs_token)
+                if acc.seconds > 0.0:
+                    self._chip_seconds += acc.seconds
+                    child = self._m_chip.get(method)
+                    if child is None:
+                        child = self._m_chip[method] = CHIP_SECONDS.labels(
+                            self.app_id, self.deployment_name, method
+                        )
+                    child.inc(acc.seconds)
+                if m_on and self._m_latency is not None:
+                    self._m_latency.observe(time.monotonic() - t_exec)
+                self._ongoing -= 1
+                if self._ongoing == 0:
+                    self._idle_event.set()
+        finally:
+            self._semaphore.release()
+
     async def call_bounded(
         self,
         method: str,

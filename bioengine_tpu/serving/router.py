@@ -116,6 +116,31 @@ REQUEST_HEDGES = metrics.counter(
     "hedge attempts launched for idempotent calls, by winning attempt",
     ("app", "deployment", "winner"),
 )
+# token-streaming request path (DeploymentHandle.call_stream):
+# inter_token_seconds is the generative-serving SLO signal (slo.py's
+# inter_token_ms objective reads its buckets) — the FIRST item's gap is
+# time-to-first-token and lands in ttft_seconds instead, so inter-token
+# percentiles aren't polluted by prefill+route time
+TOKENS_GENERATED = metrics.counter(
+    "tokens_generated_total",
+    "stream items yielded to callers by DeploymentHandle.call_stream",
+    ("app", "deployment"),
+)
+INTER_TOKEN = metrics.histogram(
+    "inter_token_seconds",
+    "gap between consecutive stream items at the caller edge",
+    ("app", "deployment"),
+)
+TTFT = metrics.histogram(
+    "ttft_seconds",
+    "call_stream start to first item (route + prefill + first frame)",
+    ("app", "deployment"),
+)
+STREAM_RESUMES = metrics.counter(
+    "stream_resumes_total",
+    "mid-stream failovers resumed on another replica (idempotent calls)",
+    ("app", "deployment"),
+)
 
 
 @dataclass(frozen=True)
@@ -376,6 +401,230 @@ class DeploymentHandle:
                     outcome=outcome,
                     trace_id=ctx.trace_id if ctx else None,
                 )
+
+    async def call_stream(self, method: str, *args, **kwargs):
+        """Streaming twin of :meth:`call`: routes to one replica and
+        yields items (tokens) as they arrive. Streams bypass the
+        request scheduler's coalescing — step-level batching happens
+        INSIDE the replica's decode loop (serving/decode.py), which is
+        the whole point — but reuse the same replica pick, breaker
+        bookkeeping, and failover discipline.
+
+        Mid-stream transport failure on an idempotent call resumes on
+        another replica with ``resume_from=<items already yielded>``:
+        greedy decoding is deterministic, so the new replica regenerates
+        and skips the prefix — the caller sees an uninterrupted,
+        exactly-once token sequence (``decode.stream_resume`` in the
+        flight ring marks the seam). Non-idempotent streams fail typed
+        instead. Application errors are never retried."""
+        options = kwargs.pop("options", None)
+        if options is not None and not isinstance(options, RequestOptions):
+            kwargs["options"] = options
+            options = None
+        options = options or self._options or RequestOptions.defaults()
+
+        parent = tracing.current_trace()
+        ctx = parent if parent is not None else tracing.maybe_start_trace()
+        token = (
+            tracing.activate(ctx)
+            if ctx is not None and parent is None
+            else None
+        )
+        m_on = metrics.metrics_enabled()
+        deadline = (
+            time.monotonic() + options.deadline_s
+            if options.deadline_s is not None
+            else None
+        )
+        tried: set[str] = set()
+        yielded = 0
+        base_resume = int(kwargs.get("resume_from", 0) or 0)
+        attempt = 0
+        t0 = time.monotonic()
+        t_last: Optional[float] = None
+        outcome = "ok"
+        try:
+            while True:
+                attempt += 1
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    raise DeadlineExceeded(
+                        f"deadline exhausted after {attempt - 1} attempt(s) "
+                        f"for {self.app_id}/{self.deployment}.{method}"
+                    )
+                t_route = time.monotonic()
+                with tracing.trace_span_t("route", self._ts_route):
+                    replica = await self._controller._pick_replica_wait(
+                        self.app_id, self.deployment, avoid=tried,
+                        deadline=deadline,
+                    )
+                if m_on:
+                    self._m_route_wait.observe(time.monotonic() - t_route)
+                attempt_kwargs = kwargs
+                if yielded > 0:
+                    attempt_kwargs = dict(kwargs)
+                    attempt_kwargs["resume_from"] = base_resume + yielded
+                got_any_this_attempt = False
+                try:
+                    with (
+                        tracing.span(
+                            "stream_attempt",
+                            replica=replica.replica_id,
+                            attempt=attempt,
+                        )
+                        if tracing.sampled()
+                        else tracing.NOOP_SPAN
+                    ):
+                        async for item in replica.call_stream(
+                            method, *args, **attempt_kwargs
+                        ):
+                            now = time.monotonic()
+                            if yielded == 0:
+                                if m_on:
+                                    self._m_ttft().observe(now - t0)
+                            elif t_last is not None and m_on:
+                                self._m_inter_token().observe(now - t_last)
+                            t_last = now
+                            yielded += 1
+                            got_any_this_attempt = True
+                            if m_on:
+                                self._m_tokens().inc()
+                            yield item
+                    self._controller._breaker_success(replica)
+                    return
+                except Exception as e:
+                    kind = classify_exception(e)
+                    if kind is FailureKind.APPLICATION:
+                        raise
+                    if not is_caller_timeout(e):
+                        self._controller._breaker_failure(replica, e)
+                    tried.add(replica.replica_id)
+                    if isinstance(e, DeadlineExceeded):
+                        raise
+                    remaining = (
+                        None
+                        if deadline is None
+                        else deadline - time.monotonic()
+                    )
+                    if remaining is not None and remaining <= 0:
+                        raise DeadlineExceeded(
+                            f"deadline exhausted after {attempt} attempt(s): {e}"
+                        ) from e
+                    # once items have been yielded, ONLY an idempotent
+                    # stream may resume (deterministic regeneration);
+                    # before first item the not-executed rule applies
+                    not_executed = isinstance(
+                        e, ReplicaUnavailableError
+                    ) and not isinstance(e, RemoteError)
+                    if not options.idempotent and not (
+                        not_executed and not got_any_this_attempt
+                    ):
+                        raise RetryableTransportError(
+                            f"{self.app_id}/{self.deployment}.{method} "
+                            f"stream failed on {replica.replica_id} after "
+                            f"{yielded} item(s) (non-idempotent, not "
+                            f"resumed): {e}"
+                        ) from e
+                    if attempt >= options.max_attempts:
+                        raise RetryableTransportError(
+                            f"{self.app_id}/{self.deployment}.{method} "
+                            f"stream failed after {attempt} attempts "
+                            f"({yielded} item(s) delivered): {e}"
+                        ) from e
+                    if m_on:
+                        self._m_failovers.inc()
+                    if yielded > 0:
+                        if m_on:
+                            self._m_resumes().inc()
+                        flight.record(
+                            "decode.stream_resume",
+                            severity="warning",
+                            app=self.app_id,
+                            deployment=self.deployment,
+                            method=method,
+                            replica=replica.replica_id,
+                            resume_from=base_resume + yielded,
+                            attempt=attempt,
+                            error=str(e)[:300],
+                        )
+                    else:
+                        flight.record(
+                            "request.failover",
+                            severity="warning",
+                            app=self.app_id,
+                            deployment=self.deployment,
+                            method=method,
+                            replica=replica.replica_id,
+                            attempt=attempt,
+                            error=str(e)[:300],
+                        )
+                    delay = full_jitter_delay(
+                        attempt - 1,
+                        options.backoff_base_s,
+                        options.backoff_cap_s,
+                    )
+                    if remaining is not None:
+                        delay = min(delay, max(0.0, remaining))
+                    await asyncio.sleep(delay)
+        except Exception as e:
+            kind = classify_exception(e)
+            outcome = {
+                FailureKind.APPLICATION: "app_error",
+                FailureKind.DEADLINE: "deadline",
+            }.get(kind, "transport_error")
+            raise
+        finally:
+            if token is not None:
+                tracing.deactivate(token)
+            if m_on:
+                e2e = self._m_e2e.get(method)
+                if e2e is None:
+                    e2e = self._m_e2e[method] = REQUEST_E2E.labels(
+                        self.app_id, self.deployment, method
+                    )
+                e2e.observe(time.monotonic() - t0)
+                out_c = self._m_outcomes.get(outcome)
+                if out_c is None:
+                    out_c = self._m_outcomes[outcome] = REQUEST_OUTCOMES.labels(
+                        self.app_id, self.deployment, outcome
+                    )
+                out_c.inc()
+
+    # stream-metric children resolved lazily (streams are opt-in per
+    # deployment — a unary-only handle never materializes them)
+    def _m_tokens(self):
+        child = self.__dict__.get("_m_tokens_c")
+        if child is None:
+            child = self.__dict__["_m_tokens_c"] = TOKENS_GENERATED.labels(
+                self.app_id, self.deployment
+            )
+        return child
+
+    def _m_inter_token(self):
+        child = self.__dict__.get("_m_inter_token_c")
+        if child is None:
+            child = self.__dict__["_m_inter_token_c"] = INTER_TOKEN.labels(
+                self.app_id, self.deployment
+            )
+        return child
+
+    def _m_ttft(self):
+        child = self.__dict__.get("_m_ttft_c")
+        if child is None:
+            child = self.__dict__["_m_ttft_c"] = TTFT.labels(
+                self.app_id, self.deployment
+            )
+        return child
+
+    def _m_resumes(self):
+        child = self.__dict__.get("_m_resumes_c")
+        if child is None:
+            child = self.__dict__["_m_resumes_c"] = STREAM_RESUMES.labels(
+                self.app_id, self.deployment
+            )
+        return child
 
     async def _call_attempts(
         self, method: str, args: tuple, kwargs: dict, options: RequestOptions
@@ -1444,7 +1693,11 @@ def shared_object_resolver(controller) -> Callable:
     return resolve
 
 
-def remote_replica_resolver(call_host, payload: Optional[dict] = None) -> Callable:
+def remote_replica_resolver(
+    call_host,
+    payload: Optional[dict] = None,
+    stream_host=None,
+) -> Callable:
     """Resolver for a router in its OWN process: each table entry
     becomes a cached :class:`RemoteReplica` dialing the worker host the
     controller placed it on (``call_host`` is the same transport hook
@@ -1478,6 +1731,7 @@ def remote_replica_resolver(call_host, payload: Optional[dict] = None) -> Callab
                     dict(payload or {}),
                     device_ids=list(e.get("device_ids") or []),
                     max_ongoing_requests=int(e.get("max_ongoing", 10)),
+                    stream_host=stream_host,
                 )
                 replica.replica_id = rid
                 pool[rid] = replica

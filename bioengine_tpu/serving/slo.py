@@ -94,6 +94,7 @@ SLO_KEYS = {
     "latency_objective",
     "latency_percentile",
     "availability",
+    "inter_token_ms",
     "window",
     "for",
 }
@@ -107,6 +108,11 @@ class SLOConfig:
     latency_objective_s: Optional[float] = None
     latency_percentile: float = 99.0       # % of requests under objective
     availability: Optional[float] = None   # % of requests that succeed
+    # token-streaming deployments: % (latency_percentile) of
+    # inter-token gaps under this bound — the generative-serving SLO
+    # (time BETWEEN tokens at the caller edge; burn-rate rules apply
+    # to it exactly as to request latency)
+    inter_token_objective_s: Optional[float] = None
     window_s: float = 30 * 86400.0
     for_s: float = 0.0                     # pending hold before firing
 
@@ -125,9 +131,17 @@ class SLOConfig:
         availability = (
             float(cfg["availability"]) if "availability" in cfg else None
         )
-        if latency is None and availability is None:
+        inter_token = (
+            float(cfg["inter_token_ms"]) / 1000.0
+            if "inter_token_ms" in cfg
+            else None
+        )
+        if inter_token is not None and inter_token <= 0:
+            raise ValueError("inter_token_ms must be positive")
+        if latency is None and availability is None and inter_token is None:
             raise ValueError(
-                "slo block needs latency_objective_ms and/or availability"
+                "slo block needs latency_objective_ms, availability, "
+                "and/or inter_token_ms"
             )
         pct = float(cfg.get("latency_percentile", 99.0))
         # floor at 50: values below are either nonsense objectives or —
@@ -151,6 +165,7 @@ class SLOConfig:
             latency_objective_s=latency,
             latency_percentile=pct,
             availability=availability,
+            inter_token_objective_s=inter_token,
             window_s=window,
             for_s=parse_duration_s(cfg.get("for", 0.0)),
         )
@@ -161,10 +176,12 @@ class SLOConfig:
             out.append("latency")
         if self.availability is not None:
             out.append("availability")
+        if self.inter_token_objective_s is not None:
+            out.append("inter_token")
         return out
 
     def budget(self, objective: str) -> float:
-        if objective == "latency":
+        if objective in ("latency", "inter_token"):
             return max(1e-6, 1.0 - self.latency_percentile / 100.0)
         return max(1e-6, 1.0 - (self.availability or 100.0) / 100.0)
 
@@ -330,6 +347,20 @@ class SLOEngine:
         """(bad fraction over the window, total requests). None when
         the window holds no traffic — no traffic is not an outage."""
         agg = self.store.window_aggregate(app, dep, window_s, now=now)
+        if objective == "inter_token":
+            # the event is one inter-token GAP, not one request: the
+            # budget burns against the gap-histogram count, so a single
+            # stalled long generation burns proportionally to its stall
+            total = agg.get("inter_token_count", 0.0)
+            if total <= 0:
+                return None, 0.0
+            buckets = agg.get("inter_token_buckets", {})
+            good = 0.0
+            for edge_str, cum in buckets.items():
+                edge = math.inf if edge_str == "+Inf" else float(edge_str)
+                if edge <= cfg.inter_token_objective_s + 1e-9:
+                    good = max(good, cum)
+            return min(1.0, max(0.0, total - good) / total), total
         total = agg.get("requests", 0.0)
         if total <= 0:
             return None, 0.0
@@ -573,13 +604,18 @@ class SLOEngine:
                 budget = cfg.budget(objective)
                 objectives[objective] = {
                     "target": (
-                        cfg.latency_percentile
-                        if objective == "latency"
-                        else cfg.availability
+                        cfg.availability
+                        if objective == "availability"
+                        else cfg.latency_percentile
                     ),
                     "latency_objective_ms": (
                         round(cfg.latency_objective_s * 1000.0, 3)
                         if objective == "latency"
+                        else None
+                    ),
+                    "inter_token_objective_ms": (
+                        round(cfg.inter_token_objective_s * 1000.0, 3)
+                        if objective == "inter_token"
                         else None
                     ),
                     "window_s": cfg.window_s,

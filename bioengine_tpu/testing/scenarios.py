@@ -85,6 +85,14 @@ class Stream:
     end_tick: Optional[int] = None
     skew_keys: int = 0           # >0 → hot-key argument skew (signature skew)
     deadline_s: Optional[float] = None
+    # token streaming: drive ``gen_stream`` through
+    # DeploymentHandle.call_stream instead of the unary ``work`` call.
+    # Generation length is gen_tokens + (a % (gen_spread + 1)) — a pure
+    # function of the seeded request args, so variable-length
+    # co-batching replays exactly
+    streaming: bool = False
+    gen_tokens: int = 16
+    gen_spread: int = 0
 
     def arrivals(self, tick: int) -> int:
         if tick < self.start_tick:
@@ -191,6 +199,10 @@ class Scenario:
     # the published routing table carries a fleet-scale host membership
     # block (replicas stay local — the routing work is what's under test)
     sim_hosts: int = 0
+    # step-level decode batch cap for streaming scenarios (the
+    # deployment's DecodeLoop max_active; one slot is always the
+    # interactive reserve)
+    decode_max_active: int = 4
     # wall-clock watchdog: a livelocked run fails typed (the
     # watchdog_timeout universal invariant goes red with a flight dump)
     # instead of hanging the suite. None derives a generous budget from
@@ -224,15 +236,49 @@ deployment_config:
 
 _SOURCE = """\
 import asyncio
+import time
 
 from bioengine_tpu.rpc import schema_method
 
 
+class _ToyDecodeBackend:
+    \"\"\"Deterministic pure-python decode backend for the step-level
+    continuous batcher: token i of a sequence is a pure function of its
+    prompt (token_i = (sum(prompt) + i) % 251), so a resumed stream
+    regenerates exactly and the scenario client can verify the full
+    sequence. MUST agree with scenarios._expected_tokens.\"\"\"
+
+    step_s = {service_s}
+
+    def __init__(self):
+        self._state = {{}}
+
+    def prefill(self, seq_id, tokens):
+        base = sum(int(t) for t in tokens) % 251
+        self._state[seq_id] = [base, 1]
+        time.sleep(self.step_s)
+        return base
+
+    def step(self, seq_ids, tokens):
+        time.sleep(self.step_s)
+        out = []
+        for sid in seq_ids:
+            base, n = self._state[sid]
+            out.append((base + n) % 251)
+            self._state[sid][1] = n + 1
+        return out
+
+    def finish(self, seq_id):
+        self._state.pop(seq_id, None)
+
+
 class ScenarioDep:
     service_s = {service_s}
+    decode_max_active = {decode_max_active}
 
     def __init__(self):
         self.calls = 0
+        self._decode_loop = None
 
     @schema_method
     async def work(self, a: int, b: int, context=None):
@@ -240,7 +286,48 @@ class ScenarioDep:
         self.calls += 1
         await asyncio.sleep(self.service_s)
         return {{"sum": a + b}}
+
+    async def gen_stream(
+        self,
+        prompt,
+        max_new_tokens: int = 16,
+        klass: str = "interactive",
+        resume_from: int = 0,
+        context=None,
+    ):
+        \"\"\"Streaming generation over the step-level continuous
+        batcher (serving/decode.py) — one item per token.\"\"\"
+        from bioengine_tpu.serving.decode import DecodeLoop
+
+        if self._decode_loop is None:
+            self._decode_loop = DecodeLoop(
+                _ToyDecodeBackend(),
+                name="scenario",
+                max_active=self.decode_max_active,
+                interactive_reserve=1,
+            )
+        stream = self._decode_loop.submit(
+            [int(t) for t in prompt],
+            int(max_new_tokens),
+            klass=klass,
+            resume_from=int(resume_from or 0),
+        )
+        async for tok in stream.tokens():
+            yield {{"token": int(tok)}}
+
+    async def close(self):
+        if self._decode_loop is not None:
+            await self._decode_loop.close()
 """
+
+
+def _expected_tokens(prompt: list, n: int) -> list:
+    """Client-side mirror of ``_ToyDecodeBackend`` in ``_SOURCE``:
+    token i = (sum(prompt) + i) % 251. The streaming driver verifies
+    the WHOLE sequence against this — a resumed stream that dropped,
+    duplicated or reordered a token records ``wrong_result``."""
+    base = sum(prompt) % 251
+    return [(base + i) % 251 for i in range(n)]
 
 
 class _LocalDep:
@@ -270,7 +357,10 @@ def _build_app_dir(root: Path, scenario: Scenario) -> Path:
         manifest += "\n".join(lines) + "\n"
     (app_dir / "manifest.yaml").write_text(manifest)
     (app_dir / "scenario_dep.py").write_text(
-        _SOURCE.format(service_s=scenario.service_s)
+        _SOURCE.format(
+            service_s=scenario.service_s,
+            decode_max_active=scenario.decode_max_active,
+        )
     )
     return app_dir
 
@@ -821,13 +911,43 @@ async def run_scenario_async(
                     handle = target.get_handle(
                         plane.app_id, plane.deployment
                     )
-                    r = await handle.call(
-                        "work", req["a"], req["b"], options=opts
-                    )
-                    got = r["sum"] if isinstance(r, dict) else None
-                    outcomes[idx] = (
-                        "ok" if got == req["a"] + req["b"] else "wrong_result"
-                    )
+                    stream = req["stream"]
+                    if stream.streaming:
+                        # token streaming: drain the whole generation
+                        # through call_stream (mid-stream failover
+                        # resumes idempotently with resume_from) and
+                        # verify every token against the deterministic
+                        # backend mirror
+                        prompt = [req["a"] % 251, req["b"] % 251]
+                        n_tokens = stream.gen_tokens + (
+                            req["a"] % (stream.gen_spread + 1)
+                            if stream.gen_spread
+                            else 0
+                        )
+                        toks: list = []
+                        async for item in handle.call_stream(
+                            "gen_stream",
+                            prompt=prompt,
+                            max_new_tokens=n_tokens,
+                            klass=stream.priority or "interactive",
+                            options=opts,
+                        ):
+                            toks.append(item["token"])
+                        outcomes[idx] = (
+                            "ok"
+                            if toks == _expected_tokens(prompt, n_tokens)
+                            else "wrong_result"
+                        )
+                    else:
+                        r = await handle.call(
+                            "work", req["a"], req["b"], options=opts
+                        )
+                        got = r["sum"] if isinstance(r, dict) else None
+                        outcomes[idx] = (
+                            "ok"
+                            if got == req["a"] + req["b"]
+                            else "wrong_result"
+                        )
                 except RouterClosedError:
                     router_offset += 1
                     plane.router_failovers += 1
@@ -1062,6 +1182,8 @@ def _evaluate(
             f"{plane.router_failovers} client hop(s) to a sibling router",
         ),
         "router_staleness_bounded": lambda: _inv_router_staleness(s, plane),
+        "decode_cobatch_observed": lambda: _inv_cobatch(flight_t0),
+        "stream_resume_observed": lambda: _inv_stream_resume(flight_t0),
     }
 
     invariants: dict[str, dict] = {}
@@ -1297,6 +1419,26 @@ def _inv_adopted(flight_t0: float) -> tuple[bool, str]:
         f"{len(recovered)} controller.recovered event(s), "
         f"max adopted={adopted}"
     )
+
+
+def _inv_cobatch(flight_t0: float) -> tuple[bool, str]:
+    """Step-level continuous batching actually engaged: sequences were
+    admitted INTO running batches (``decode.join`` with mid_batch=True)
+    instead of waiting for a batch to drain — the no-head-of-line-
+    blocking evidence."""
+    joins = flight.get_events(types=("decode.join",), since=flight_t0)
+    mid = sum(1 for e in joins if e["attrs"].get("mid_batch"))
+    return mid > 0, f"{mid}/{len(joins)} join(s) entered a running batch"
+
+
+def _inv_stream_resume(flight_t0: float) -> tuple[bool, str]:
+    """A mid-generation failure was healed by idempotent stream resume
+    (``decode.stream_resume`` marks the seam) — the fault script's kill
+    really interrupted live generations, and nothing was lost."""
+    evs = flight.get_events(
+        types=("decode.stream_resume",), since=flight_t0
+    )
+    return bool(evs), f"{len(evs)} mid-stream resume(s)"
 
 
 def _inv_coalescing(plane: _Plane) -> tuple[bool, str]:
@@ -1642,6 +1784,65 @@ ROUTER_LOSS = _register(
             "bounded_queues",
             "router_failover_observed",
             "router_staleness_bounded",
+        ),
+    )
+)
+
+
+# The token-streaming acceptance scenario: interactive generations
+# arrive every tick while bursts of long bulk generations co-batch with
+# them in the replicas' step-level decode loops — the interactive
+# reserve keeps the bulk burst from occupying the whole batch, so
+# variable-length co-batching never starves short streams. Mid-run one
+# host is SIGKILL-equivalently severed while generations are in flight:
+# idempotent streams resume on the surviving replica with
+# ``resume_from`` (greedy regeneration skips the already-delivered
+# prefix), the client verifies EVERY token against the deterministic
+# backend mirror, and the lease/liveness universals prove nothing
+# leaked. hedge=False: a generation is a stateful stream — duplicate
+# attempts would double-decode, resume is the failover mechanism.
+TOKEN_STREAMING = _register(
+    Scenario(
+        name="token_streaming",
+        description=(
+            "token streaming under a long-generation burst + host kill "
+            "mid-generation: step-level co-batching, interactive never "
+            "starved, killed streams resume idempotently"
+        ),
+        ticks=90,
+        tick_s=0.02,
+        health_every=3,
+        n_hosts=2,
+        n_replicas=2,
+        chips_per_replica=2,
+        max_ongoing=32,
+        service_s=0.004,          # decode step time (see _SOURCE)
+        decode_max_active=6,
+        streams=(
+            Stream(name="interactive", priority="interactive",
+                   streaming=True, gen_tokens=6, gen_spread=4, base=1,
+                   deadline_s=15.0),
+            Stream(name="bulk", priority="bulk", streaming=True,
+                   gen_tokens=80, base=0, kind="burst", burst_every=20,
+                   burst_size=3, start_tick=20, end_tick=70,
+                   deadline_s=25.0),
+        ),
+        fault_script=(
+            FaultEvent(at_tick=45, action="kill_host", host="h1"),
+        ),
+        hedge=False,
+        deadline_s=25.0,
+        max_attempts=8,
+        slo_ms=4000.0,
+        slo_floor=0.85,
+        invariants=(
+            "zero_failed_idempotent",
+            "chip_accounting_exact",
+            "no_stuck_futures",
+            "bounded_queues",
+            "slo_attainment",
+            "decode_cobatch_observed",
+            "stream_resume_observed",
         ),
     )
 )

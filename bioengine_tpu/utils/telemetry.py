@@ -67,11 +67,20 @@ _SUM_FIELDS = (
     "chip_seconds",
     "latency_sum",
     "replica_requests",
+    # token streaming (DeploymentHandle.call_stream): generated-token
+    # count and the inter-token gap histogram's count — the SLO
+    # engine's inter_token_ms objective burns against these
+    "tokens",
+    "inter_token_count",
 )
 # gauges: point-sampled, last-write-wins within a bucket
 _GAUGE_FIELDS = ("queue_depth",)
 # bucket-delta dicts {upper_edge_str: count}
-_BUCKET_FIELDS = ("latency_buckets", "replica_latency_buckets")
+_BUCKET_FIELDS = (
+    "latency_buckets",
+    "replica_latency_buckets",
+    "inter_token_buckets",
+)
 
 SERIES_NAMES = (
     "request_rate",
@@ -84,6 +93,8 @@ SERIES_NAMES = (
     "latency_p95",
     "latency_p99",
     "replica_latency_p99",
+    "tokens_per_second",
+    "inter_token_p99",
 )
 
 
@@ -338,6 +349,15 @@ class TelemetryStore:
                 b.sums.get("replica_requests") or None,
                 q,
             )
+        if name == "tokens_per_second":
+            return round(b.sums.get("tokens", 0.0) / step, 6)
+        if name.startswith("inter_token_p"):
+            q = float(name[len("inter_token_p"):]) / 100.0
+            return quantile_from_buckets(
+                b.buckets.get("inter_token_buckets", {}),
+                b.sums.get("inter_token_count") or None,
+                q,
+            )
         return None
 
     def window_aggregate(
@@ -501,6 +521,13 @@ class RegistrySampler:
             if "requests" not in e and "requests_e2e" in e:
                 e["requests"] = e["requests_e2e"]
             e.pop("requests_e2e", None)
+        # token streaming (handle-side): generated-token throughput and
+        # the inter-token gap histogram the inter_token_ms SLO reads
+        counter_delta("tokens_generated_total", "tokens")
+        histogram_delta(
+            "inter_token_seconds", "inter_token_buckets",
+            "inter_token_count", None,
+        )
         # replica-side (worker-host process, or local placement)
         counter_delta("chip_seconds_total", "chip_seconds")
         histogram_delta(

@@ -184,6 +184,7 @@ class WorkerHost:
                 "memory_profile": self.memory_profile,
                 "start_replica": self.start_replica,
                 "replica_call": self.replica_call,
+                "replica_stream": self.replica_stream,
                 "replica_health": self.replica_health,
                 "drain_replica": self.drain_replica,
                 "stop_replica": self.stop_replica,
@@ -787,6 +788,40 @@ class WorkerHost:
         if timeout_s is None:
             return await coro
         return await asyncio.wait_for(coro, timeout_s)
+
+    async def replica_stream(
+        self,
+        replica_id: str,
+        method: str,
+        args: list,
+        kwargs: dict,
+        item_timeout_s: Optional[float] = None,
+    ):
+        """Streaming twin of :meth:`replica_call`: an async-generator
+        service verb — the RPC plane's stream1 machinery sends each
+        yielded item as its own frame (token-sized payloads ride the
+        fast-frame path). ``item_timeout_s`` bounds the gap BETWEEN
+        items, not the whole generation: a 10k-token stream is healthy
+        as long as tokens keep flowing."""
+        if faults.ACTIVE:
+            await faults.hit(
+                "host.replica_stream", drop=self._abort_connection,
+                scope=self.host_id,
+            )
+        replica = self._get(replica_id)
+        agen = replica.call_stream(method, *(args or []), **(kwargs or {}))
+        try:
+            while True:
+                nxt = agen.__anext__()
+                if item_timeout_s is not None:
+                    nxt = asyncio.wait_for(nxt, item_timeout_s)
+                try:
+                    item = await nxt
+                except StopAsyncIteration:
+                    break
+                yield item
+        finally:
+            await agen.aclose()
 
     async def _abort_connection(self) -> None:
         """Fault-injection hook: sever our control-plane websocket as a

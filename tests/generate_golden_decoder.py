@@ -1,0 +1,151 @@
+"""Generate tests/fixtures_golden_decoder.npz — an INDEPENDENT numpy
+implementation of the toy char-level decoder used as ground truth by
+``tests/test_decode.py::TestGoldenDecoder``.
+
+The DecodeEngine's math (runtime/decode_engine.py: decoder_prefill /
+decoder_step) is pinned against this second implementation, which
+shares no code with it: plain numpy, a single unbatched full-attention
+forward per position, no KV cache, no padding buckets, no jax. If the
+engine's bucketed/paged execution diverges from a straightforward
+transformer forward — mask bug, KV gather off-by-one, bucket padding
+leaking into the softmax — the fixture catches it.
+
+Committed so the fixture is reproducible:
+``python tests/generate_golden_decoder.py`` rewrites the npz
+deterministically (seeded init, greedy decoding).
+
+Fixture contents:
+  prompt          [T]        int32 — the test prompt ("the cell divides")
+  prefill_logits  [vocab]    f32   — logits at the last prompt position
+  step_logits     [vocab]    f32   — logits after one greedy decode step
+  greedy_tokens   [32]       int32 — 32 greedy continuation tokens
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+OUT = Path(__file__).parent / "fixtures_golden_decoder.npz"
+
+PROMPT = "the cell divides"
+N_TOKENS = 32
+
+# mirrors DecoderConfig defaults; duplicated on purpose — the fixture
+# must not import the module it pins
+VOCAB, D_MODEL, N_HEADS, N_LAYERS, D_FF, MAX_LEN = 256, 64, 4, 2, 128, 512
+HEAD_DIM = D_MODEL // N_HEADS
+
+
+def init_params(seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+
+    def w(*shape, scale):
+        return rng.normal(0.0, scale, size=shape).astype(np.float32)
+
+    params = {
+        "tok_emb": w(VOCAB, D_MODEL, scale=0.02),
+        "pos_emb": w(MAX_LEN, D_MODEL, scale=0.02),
+        "ln_f_g": np.ones((D_MODEL,), np.float32),
+        "ln_f_b": np.zeros((D_MODEL,), np.float32),
+        "layers": [],
+    }
+    for _ in range(N_LAYERS):
+        params["layers"].append(
+            {
+                "ln1_g": np.ones((D_MODEL,), np.float32),
+                "ln1_b": np.zeros((D_MODEL,), np.float32),
+                "wq": w(D_MODEL, D_MODEL, scale=D_MODEL**-0.5),
+                "wk": w(D_MODEL, D_MODEL, scale=D_MODEL**-0.5),
+                "wv": w(D_MODEL, D_MODEL, scale=D_MODEL**-0.5),
+                "wo": w(D_MODEL, D_MODEL, scale=D_MODEL**-0.5),
+                "ln2_g": np.ones((D_MODEL,), np.float32),
+                "ln2_b": np.zeros((D_MODEL,), np.float32),
+                "w1": w(D_MODEL, D_FF, scale=D_MODEL**-0.5),
+                "b1": np.zeros((D_FF,), np.float32),
+                "w2": w(D_FF, D_MODEL, scale=D_FF**-0.5),
+                "b2": np.zeros((D_MODEL,), np.float32),
+            }
+        )
+    return params
+
+
+def ln(x, g, b):
+    mu = x.mean(axis=-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(axis=-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + 1e-5) * g + b
+
+
+def gelu(x):
+    # jax.nn.gelu default is the tanh approximation
+    return 0.5 * x * (
+        1.0 + np.tanh(np.sqrt(2.0 / np.pi) * (x + 0.044715 * x**3))
+    )
+
+
+def softmax(x, axis=-1):
+    x = x - x.max(axis=axis, keepdims=True)
+    e = np.exp(x)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def forward(params: dict, tokens: np.ndarray) -> np.ndarray:
+    """Full-sequence causal forward; returns logits at the LAST
+    position. No cache, no padding — the simplest correct transformer,
+    recomputed from scratch each call."""
+    T = len(tokens)
+    x = params["tok_emb"][tokens] + params["pos_emb"][:T]
+    causal = np.tril(np.ones((T, T), bool))
+    mask = np.where(causal, 0.0, -1e30).astype(np.float32)
+    for layer in params["layers"]:
+        h = ln(x, layer["ln1_g"], layer["ln1_b"])
+        q = (h @ layer["wq"]).reshape(T, N_HEADS, HEAD_DIM)
+        k = (h @ layer["wk"]).reshape(T, N_HEADS, HEAD_DIM)
+        v = (h @ layer["wv"]).reshape(T, N_HEADS, HEAD_DIM)
+        scores = (
+            np.einsum("qhd,khd->hqk", q, k) * HEAD_DIM**-0.5 + mask[None]
+        )
+        attn = softmax(scores, axis=-1)
+        out = np.einsum("hqk,khd->qhd", attn, v).reshape(T, D_MODEL)
+        x = x + out @ layer["wo"]
+        h = ln(x, layer["ln2_g"], layer["ln2_b"])
+        x = x + gelu(h @ layer["w1"] + layer["b1"]) @ layer["w2"] + layer["b2"]
+    x = ln(x, params["ln_f_g"], params["ln_f_b"])
+    return x[-1] @ params["tok_emb"].T
+
+
+def main() -> None:
+    params = init_params(0)
+    prompt = np.array([ord(c) % 256 for c in PROMPT], np.int32)
+
+    prefill_logits = forward(params, prompt)
+    seq = list(prompt)
+    greedy = []
+    step_logits = None
+    for i in range(N_TOKENS):
+        logits = prefill_logits if i == 0 else forward(
+            params, np.array(seq, np.int32)
+        )
+        nxt = int(np.argmax(logits))
+        greedy.append(nxt)
+        seq.append(nxt)
+        if i == 1:
+            # logits that produced the SECOND generated token — i.e.
+            # the engine's first decoder_step output (prefill produces
+            # the first)
+            step_logits = logits
+
+    np.savez_compressed(
+        OUT,
+        prompt=prompt,
+        prefill_logits=prefill_logits.astype(np.float32),
+        step_logits=step_logits.astype(np.float32),
+        greedy_tokens=np.array(greedy, np.int32),
+    )
+    print(f"wrote {OUT} ({OUT.stat().st_size} bytes)")
+    print("greedy:", greedy)
+
+
+if __name__ == "__main__":
+    main()

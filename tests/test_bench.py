@@ -565,6 +565,63 @@ def test_router_scaling_stage_schema():
         assert probe[leg]["p50_us"] > 0, probe
 
 
+def test_token_streaming_stage_schema():
+    """Pin the token_streaming artifact schema: the co-batched
+    throughput leg must show real step-level batching (mean occupancy
+    above 1, far fewer steps than serial token count), the inter-token
+    leg reports the first-class latency SLO numbers, and the
+    join-mid-batch leg proves no head-of-line blocking — the short
+    interactive stream joined a RUNNING batch and finished while the
+    long bulk generation was still going."""
+    proc, lines = _run(
+        {
+            "BENCH_CONFIGS": "token_streaming",
+            "BENCH_DEADLINE": "160",
+        },
+        timeout=200.0,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    st = json.loads(lines[-1])["extra"]["token_streaming"]
+    assert st["ok"], st
+    tp = st["throughput"]
+    for key in (
+        "streams",
+        "new_tokens_each",
+        "tokens_per_sec",
+        "tokens_per_sec_per_chip",
+        "batch_occupancy",
+        "steps",
+        "wall_s",
+    ):
+        assert key in tp, key
+    assert tp["tokens_per_sec"] > 0
+    assert tp["tokens_per_sec_per_chip"] > 0
+    # continuous batching engaged: sequences shared steps
+    assert tp["batch_occupancy"] > 1.0, tp
+    assert tp["steps"] < tp["streams"] * tp["new_tokens_each"], tp
+    it = st["inter_token"]
+    for key in ("ttft_ms", "inter_token_p50_ms", "inter_token_p99_ms"):
+        assert key in it, key
+        assert it[key] > 0, it
+    assert it["inter_token_p99_ms"] >= it["inter_token_p50_ms"]
+    jm = st["join_mid_batch"]
+    for key in (
+        "joined_mid_batch",
+        "mid_batch_ttft_ms",
+        "short_wall_ms",
+        "long_still_running",
+        "long_tokens",
+    ):
+        assert key in jm, key
+    # the no-HOL-blocking proof rides the artifact, not just a test
+    assert jm["joined_mid_batch"] == 1, jm
+    assert jm["long_still_running"] == 1, jm
+    assert jm["mid_batch_ttft_ms"] > 0, jm
+    eng = st["engine"]
+    assert eng["n_devices"] >= 1
+    assert eng["kv_block_size"] >= 1
+
+
 def _artifact(vit=1000.0, pipelined=2.0, p50_us=100.0) -> dict:
     """A minimal bench artifact in the real schema, tunable per metric."""
     return {
@@ -648,6 +705,52 @@ def test_compare_mode_schema_and_exit_codes(tmp_path):
     # direction inference: the slower pipelined_s also halves speedup —
     # a higher-is-better metric moving DOWN is a regression too
     assert "pipeline_overlap.speedup" in regressed
+
+
+def test_compare_token_streaming_directions(tmp_path):
+    """Direction inference on the streaming metrics: tokens_per_sec /
+    batch_occupancy are higher-is-better (a drop regresses), the
+    inter-token percentiles are lower-is-better (a rise regresses) —
+    so a compare gate catches a co-batching break from either side."""
+
+    def art(tps, occ, p99):
+        a = _artifact()
+        a["extra"]["token_streaming"] = {
+            "ok": True,
+            "throughput": {
+                "tokens_per_sec": tps,
+                "batch_occupancy": occ,
+            },
+            "inter_token": {"inter_token_p99_ms": p99},
+        }
+        return a
+
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(art(2000.0, 8.0, 3.0)))
+    # throughput/occupancy DOWN, tail latency UP: all three must flag
+    b.write_text(json.dumps(art(1200.0, 4.0, 9.0)))
+    proc = subprocess.run(
+        [sys.executable, str(BENCH), "--compare", str(a), str(b)],
+        capture_output=True,
+        text=True,
+        timeout=60,
+        cwd=str(BENCH.parent),
+    )
+    assert proc.returncode == 1
+    report = json.loads(
+        [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")][-1]
+    )
+    stage = report["stages"]["token_streaming"]
+    assert stage["throughput.tokens_per_sec"]["direction"] == "higher"
+    assert stage["throughput.batch_occupancy"]["direction"] == "higher"
+    assert stage["inter_token.inter_token_p99_ms"]["direction"] == "lower"
+    regressed = {r["metric"] for r in report["regressions"]}
+    assert {
+        "token_streaming.throughput.tokens_per_sec",
+        "token_streaming.throughput.batch_occupancy",
+        "token_streaming.inter_token.inter_token_p99_ms",
+    } <= regressed
 
 
 def test_compare_usage_error_is_json_not_traceback(tmp_path):

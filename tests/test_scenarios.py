@@ -155,6 +155,7 @@ class TestScenarioVocabulary:
             "hot_signature",
             "tenant_flood",
             "controller_crash",
+            "token_streaming",
         } <= names
         assert len(names) >= 5
         with pytest.raises(KeyError, match="unknown scenario"):
@@ -254,6 +255,30 @@ class TestScenarioRuns:
         assert inv["replicas_adopted"]["ok"], inv
         assert inv["epoch_fencing_observed"]["ok"], inv
         assert r1["passed"], inv
+        assert r1["counts"] == {"ok": r1["requests"]}
+        r2 = await run_scenario_async(scenario, seed=7)
+        assert outcome_signature(r1) == outcome_signature(r2)
+
+    async def test_token_streaming_survives_host_kill_with_cobatching(self):
+        """The streaming acceptance scenario: mixed interactive/bulk
+        token streams over 2 hosts, a host SIGKILL'd mid-generation at
+        tick 45. Every request must verify its WHOLE token sequence
+        against the client-side decoder mirror (a resumed stream that
+        dropped/duplicated a token records wrong_result), co-batching
+        must be observed (mid-batch joins), the kill must force real
+        mid-stream resumes, and chip accounting stays exact — a
+        co-batched stream bills its fair share, not the whole batch.
+        Deterministic for one seed (the replay gate)."""
+        scenario = get_scenario("token_streaming")
+        r1 = await run_scenario_async(scenario, seed=7)
+        inv = r1["invariants"]
+        assert inv["zero_failed_idempotent"]["ok"], inv
+        assert inv["chip_accounting_exact"]["ok"], inv
+        assert inv["decode_cobatch_observed"]["ok"], inv
+        assert inv["stream_resume_observed"]["ok"], inv
+        assert inv["slo_attainment"]["ok"], inv
+        assert r1["passed"], inv
+        # every stream delivered its exact expected token sequence
         assert r1["counts"] == {"ok": r1["requests"]}
         r2 = await run_scenario_async(scenario, seed=7)
         assert outcome_signature(r1) == outcome_signature(r2)

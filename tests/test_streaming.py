@@ -1,0 +1,434 @@
+"""Token streaming end to end: the ``generate`` app through the
+serving plane, mid-stream failover with exactly-once resume, the RPC
+stream1 plane, and the mesh-manifest parity unlock.
+
+Layer map (bottom-up):
+
+- ``TestRpcStreamPlane`` — streaming calls over a REAL websocket:
+  per-item ordering, typed mid-stream application errors, and the
+  provider-generator lifecycle pin (abandoning a stream closes the
+  provider's async generator deterministically — its ``finally`` runs
+  NOW, not at GC; that is what keeps replica ongoing-counts and decode
+  slots from stranding until drain timeouts).
+- ``TestStreamFailover`` — ``DeploymentHandle.call_stream`` resumes an
+  idempotent stream on another replica with ``resume_from=<yielded>``
+  after a mid-stream transport failure: the consumer sees an
+  uninterrupted exactly-once sequence and ``decode.stream_resume``
+  marks the seam. Non-idempotent streams fail typed instead.
+- ``TestGenerateApp`` — the shipped ``apps/generate`` manifest deployed
+  unmodified: stream == unary == the golden fixture's greedy tokens,
+  ``resume_from`` emits exactly the missing suffix.
+- ``TestMeshManifestParity`` — the SAME app sources with a ``mesh:``
+  block (1 stage x 2 chips, dp axes) deployed over a real worker-host
+  plane: bit-identical greedy tokens to the 1-chip deploy (both pin the
+  golden fixture), streaming included — the sharded-decoder unlock is a
+  manifest edit.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from bioengine_tpu.apps.builder import AppBuilder
+from bioengine_tpu.cluster.state import ClusterState
+from bioengine_tpu.cluster.topology import TpuTopology
+from bioengine_tpu.rpc.client import connect_to_server
+from bioengine_tpu.rpc.server import RpcServer
+from bioengine_tpu.serving import (
+    DeploymentSpec,
+    RequestOptions,
+    ServeController,
+)
+from bioengine_tpu.serving.errors import RetryableTransportError
+from bioengine_tpu.utils import flight
+from bioengine_tpu.worker_host import WorkerHost
+
+pytestmark = [pytest.mark.integration, pytest.mark.anyio]
+
+APP_DIR = Path(__file__).resolve().parent.parent / "apps" / "generate"
+FIXTURE = Path(__file__).parent / "fixtures_golden_decoder.npz"
+GOLDEN_PROMPT = "the cell divides"
+
+
+# ---------------------------------------------------------------------------
+# RPC stream plane
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+async def rpc_server():
+    srv = RpcServer(admin_users=["admin"])
+    await srv.start()
+    yield srv
+    await srv.stop()
+
+
+@pytest.fixture
+async def rpc_conn(rpc_server):
+    token = rpc_server.issue_token("admin")
+    conn = await connect_to_server(
+        {"server_url": f"http://127.0.0.1:{rpc_server.port}", "token": token}
+    )
+    yield conn
+    await conn.disconnect()
+
+
+class TestRpcStreamPlane:
+    async def test_remote_stream_items_arrive_in_order(
+        self, rpc_server, rpc_conn
+    ):
+        async def countdown(n: int = 5, context=None):
+            for i in range(n):
+                await asyncio.sleep(0.001)
+                yield {"i": i}
+
+        await rpc_conn.register_service(
+            {"id": "gen-svc", "countdown": countdown}
+        )
+        items = [
+            item
+            async for item in rpc_conn.call_stream("gen-svc", "countdown", n=7)
+        ]
+        assert [it["i"] for it in items] == list(range(7))
+
+    async def test_mid_stream_application_error_is_raised(
+        self, rpc_server, rpc_conn
+    ):
+        async def explode(context=None):
+            yield 1
+            yield 2
+            raise ValueError("boom mid-stream")
+
+        await rpc_conn.register_service({"id": "boom-svc", "explode": explode})
+        got = []
+        with pytest.raises(Exception, match="boom mid-stream"):
+            async for item in rpc_conn.call_stream("boom-svc", "explode"):
+                got.append(item)
+        assert got == [1, 2]
+
+    async def test_abandoned_stream_closes_provider_generator(
+        self, rpc_server
+    ):
+        """The resource-lifecycle pin: a consumer that stops consuming
+        (disconnect, break, send failure) must close the provider's
+        generator NOW — the generator's ``finally`` is what releases
+        decode slots and replica ongoing-counts, and leaving it to GC
+        is exactly the stranded-drain leak this pins against."""
+        closed = asyncio.Event()
+
+        async def infinite(context=None):
+            try:
+                i = 0
+                while True:
+                    yield i
+                    i += 1
+                    await asyncio.sleep(0)
+            finally:
+                closed.set()
+
+        rpc_server.register_local_service(
+            {"id": "leak-svc", "infinite": infinite}
+        )
+        caller = rpc_server.validate_token(rpc_server.issue_token("admin"))
+        agen = rpc_server.call_service_stream(
+            "leak-svc", "infinite", (), {}, caller=caller
+        )
+        got = []
+        async for item in agen:
+            got.append(item)
+            if len(got) == 3:
+                break
+        await agen.aclose()
+        assert got == [0, 1, 2]
+        await asyncio.wait_for(closed.wait(), 5.0)
+
+
+# ---------------------------------------------------------------------------
+# handle-level mid-stream failover
+# ---------------------------------------------------------------------------
+
+# module-level so both replicas' instances share the arming state: the
+# FIRST stream attempt (whichever replica the router picks) dies
+# mid-stream, the resumed attempt completes
+_FLAKY = {"armed": False}
+
+
+def _flaky_tokens(n: int) -> list:
+    return [(i * i) % 101 for i in range(n)]
+
+
+class _FlakyGen:
+    async def gen(self, n: int = 10, resume_from: int = 0):
+        full = _flaky_tokens(n)
+        for i in range(int(resume_from or 0), n):
+            await asyncio.sleep(0.001)
+            yield {"token": full[i], "index": i}
+            if _FLAKY["armed"] and i == 2:
+                _FLAKY["armed"] = False
+                raise RetryableTransportError(
+                    "simulated transport drop mid-stream"
+                )
+
+
+@pytest.fixture
+async def flaky_controller():
+    c = ServeController(ClusterState(), health_check_period=3600)
+    await c.deploy(
+        "app",
+        [
+            DeploymentSpec(
+                name="dep",
+                instance_factory=_FlakyGen,
+                num_replicas=2,
+                min_replicas=2,
+                max_replicas=2,
+                autoscale=False,
+            )
+        ],
+    )
+    yield c
+    _FLAKY["armed"] = False
+    await c.stop()
+
+
+class TestStreamFailover:
+    async def test_idempotent_stream_resumes_exactly_once(
+        self, flaky_controller
+    ):
+        """Mid-stream transport failure after 3 yielded items: the
+        handle fails over with ``resume_from=3``, the consumer sees the
+        full sequence exactly once, and the seam is flight-marked."""
+        _FLAKY["armed"] = True
+        t0 = time.time()
+        handle = flaky_controller.get_handle("app", "dep")
+        items = [
+            item
+            async for item in handle.call_stream(
+                "gen",
+                n=10,
+                options=RequestOptions(idempotent=True, deadline_s=30),
+            )
+        ]
+        assert [it["token"] for it in items] == _flaky_tokens(10)
+        assert [it["index"] for it in items] == list(range(10))
+        assert not _FLAKY["armed"]  # the failure really fired
+        evs = flight.get_events(types=("decode.stream_resume",), since=t0)
+        assert evs, "resume must be flight-marked"
+        assert evs[-1]["attrs"]["resume_from"] == 3
+        assert evs[-1]["attrs"]["attempt"] == 1
+
+    async def test_non_idempotent_stream_fails_typed_after_items(
+        self, flaky_controller
+    ):
+        _FLAKY["armed"] = True
+        handle = flaky_controller.get_handle("app", "dep")
+        got = []
+        with pytest.raises(RetryableTransportError, match="non-idempotent"):
+            async for item in handle.call_stream(
+                "gen",
+                n=10,
+                options=RequestOptions(idempotent=False, deadline_s=30),
+            ):
+                got.append(item)
+        assert len(got) == 3  # items before the drop were delivered
+
+    async def test_clean_stream_no_resume_events(self, flaky_controller):
+        t0 = time.time()
+        handle = flaky_controller.get_handle("app", "dep")
+        items = [
+            item
+            async for item in handle.call_stream(
+                "gen", n=6, options=RequestOptions(idempotent=True)
+            )
+        ]
+        assert [it["token"] for it in items] == _flaky_tokens(6)
+        assert not flight.get_events(
+            types=("decode.stream_resume",), since=t0
+        )
+
+
+# ---------------------------------------------------------------------------
+# the shipped generate app (unmodified manifest, local 1-chip replica)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+async def generate_controller(tmp_path):
+    controller = ServeController(ClusterState(), health_check_period=3600)
+    builder = AppBuilder(
+        workdir_root=tmp_path / "apps", admin_users=["admin"], log_file="off"
+    )
+    built = builder.build(app_id="generate", local_path=str(APP_DIR))
+    await controller.deploy("generate", built.specs)
+    for _ in range(600):
+        reps = controller.apps["generate"].replicas["generate_deployment"]
+        if reps and all(r.state.value == "HEALTHY" for r in reps):
+            break
+        await asyncio.sleep(0.05)
+    else:
+        raise RuntimeError("generate replicas never became healthy")
+    yield controller
+    await controller.stop()
+
+
+class TestGenerateApp:
+    async def test_stream_equals_unary_equals_golden_and_resumes(
+        self, generate_controller
+    ):
+        """One deploy, the full contract: the streamed token sequence
+        equals the unary drain, both equal the golden fixture's greedy
+        continuation (the app really serves the pinned decoder), and a
+        ``resume_from`` call emits exactly the missing suffix."""
+        golden = np.load(FIXTURE)["greedy_tokens"].tolist()
+        handle = generate_controller.get_handle("generate")
+        opts = RequestOptions(idempotent=True, deadline_s=120)
+
+        unary = await handle.call(
+            "generate", prompt=GOLDEN_PROMPT, max_new_tokens=16, options=opts
+        )
+        assert unary["tokens"] == golden[:16]
+
+        streamed = []
+        async for item in handle.call_stream(
+            "generate_stream",
+            prompt=GOLDEN_PROMPT,
+            max_new_tokens=16,
+            options=opts,
+        ):
+            streamed.append(item["token"])
+        assert streamed == unary["tokens"]
+
+        resumed = []
+        async for item in handle.call_stream(
+            "generate_stream",
+            prompt=GOLDEN_PROMPT,
+            max_new_tokens=16,
+            resume_from=11,
+            options=opts,
+        ):
+            resumed.append(item["token"])
+            assert item["index"] >= 11
+        assert resumed == golden[11:16]
+
+        st = await handle.call("describe_engine", options=opts)
+        assert st["engine"]["n_devices"] == 1
+        assert st["loop"]["tokens"] >= 32
+        # every finished stream released its KV blocks
+        assert st["engine"]["kv"]["sequences"] == 0
+
+
+# ---------------------------------------------------------------------------
+# mesh-manifest parity over a real worker-host plane
+# ---------------------------------------------------------------------------
+
+MESH_GENERATE_MANIFEST = """\
+name: Generate (mesh)
+id: generate-mesh
+id_emoji: "✒️"
+description: the generate app over a forced multi-device dp mesh
+type: tpu-serve
+version: 1.0.0
+deployments:
+  - generate_deployment:GenerateDeployment
+authorized_users: ["*"]
+deployment_config:
+  generate_deployment:
+    num_replicas: 1
+    min_replicas: 1
+    max_replicas: 1
+    autoscale: false
+    mesh:
+      stages: 1
+      chips_per_stage: 2
+      kind: dp
+      axes:
+        dp: -1
+"""
+
+
+class TestMeshManifestParity:
+    async def test_mesh_decoder_matches_golden_tokens(self, tmp_path):
+        """The sharded-decoder unlock: the SAME deployment source with a
+        ``mesh:`` block (1 stage x 2 chips, dp over the step batch)
+        deployed over a real worker-host plane produces BIT-IDENTICAL
+        greedy tokens to the 1-chip deploy — both pin the golden
+        fixture — and streams through the mesh replica's stream bridge.
+        Scaling the decoder is a manifest edit, not a code change."""
+        golden = np.load(FIXTURE)["greedy_tokens"].tolist()
+
+        server = RpcServer(host="127.0.0.1", admin_users=["admin"])
+        await server.start()
+        token = server.issue_token("admin", is_admin=True)
+        controller = ServeController(
+            ClusterState(TpuTopology(chips=(), n_hosts=1, platform="cpu")),
+            health_check_period=3600,
+        )
+        controller.attach_rpc(server, admin_users=["admin"])
+        host = WorkerHost(
+            server_url=server.url,
+            token=token,
+            host_id="h1",
+            workspace_dir=tmp_path / "ws-h1",
+            rejoin=True,
+        )
+        await host.start()
+        try:
+            app_dir = tmp_path / "generate-mesh-src"
+            app_dir.mkdir()
+            (app_dir / "manifest.yaml").write_text(MESH_GENERATE_MANIFEST)
+            (app_dir / "generate_deployment.py").write_text(
+                (APP_DIR / "generate_deployment.py").read_text()
+            )
+            builder = AppBuilder(workdir_root=tmp_path / "apps")
+            built = builder.build(
+                app_id="generate-mesh", local_path=app_dir
+            )
+            await controller.deploy("generate-mesh", built.specs)
+            replicas = controller.apps["generate-mesh"].replicas[
+                "generate_deployment"
+            ]
+            assert len(replicas) == 1
+            mesh = replicas[0]
+            # the lease is real: 2 chips on the joined host, billed to
+            # the mesh replica
+            rec = controller.cluster_state.hosts["h1"]
+            assert list(rec.chips_in_use.values()) == [mesh.replica_id] * 2
+
+            handle = controller.get_handle("generate-mesh")
+            opts = RequestOptions(idempotent=True, deadline_s=180)
+            out = await handle.call(
+                "generate",
+                prompt=GOLDEN_PROMPT,
+                max_new_tokens=16,
+                options=opts,
+            )
+            assert out["tokens"] == golden[:16], (
+                "dp-mesh decoder diverged from the golden greedy tokens"
+            )
+
+            st = await handle.call("describe_engine", options=opts)
+            assert st["engine"]["n_devices"] == 2
+            assert st["engine"]["mesh"] == {"dp": 2}
+
+            # streaming rides the mesh stream bridge end to end
+            streamed = []
+            async for item in handle.call_stream(
+                "generate_stream",
+                prompt=GOLDEN_PROMPT,
+                max_new_tokens=12,
+                options=opts,
+            ):
+                streamed.append(item["token"])
+            assert streamed == golden[:12]
+        finally:
+            try:
+                await host.stop()
+            except Exception:
+                pass
+            await controller.stop()
+            await server.stop()
